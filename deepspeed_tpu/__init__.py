@@ -1,0 +1,177 @@
+"""deepspeed_tpu — a TPU-native training/inference framework with the
+capability surface of DeepSpeed (reference: microsoft/DeepSpeed v0.10.2),
+re-designed for JAX/XLA/Pallas/pjit.
+
+Top-level API mirrors the reference's ``deepspeed/__init__.py``:
+``initialize`` (:64), ``init_inference`` (:269), ``add_config_arguments``
+(:246), ``init_distributed`` (:38), plus the ``zero``/``comm``/``ops``
+namespaces.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Any, Callable, Optional, Tuple
+
+__version__ = "0.1.0"
+__git_hash__ = None
+__git_branch__ = None
+
+from deepspeed_tpu.accelerator import get_accelerator
+from deepspeed_tpu import comm as comm
+from deepspeed_tpu.comm.comm import init_distributed
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.lr_schedules import add_tuning_arguments
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+dist = comm
+
+HAS_TRITON = False  # parity probe (deepspeed/__init__.py:15); TPU uses Pallas
+
+
+def initialize(
+    args=None,
+    model=None,
+    optimizer=None,
+    model_parameters=None,
+    training_data=None,
+    lr_scheduler=None,
+    mpu=None,
+    dist_init_required: Optional[bool] = None,
+    collate_fn: Optional[Callable] = None,
+    config: Any = None,
+    config_params: Any = None,
+    loss_fn: Optional[Callable] = None,
+) -> Tuple[Any, Any, Any, Any]:
+    """Build the training engine (reference ``deepspeed.initialize``
+    ``deepspeed/__init__.py:64``).
+
+    Returns ``(engine, optimizer, training_dataloader, lr_scheduler)``.
+    Selects ``PipelineEngine`` for a ``PipelineModule`` and the hybrid engine
+    when ``hybrid_engine.enabled``, else ``DeepSpeedEngine``
+    (reference :158-196).
+    """
+    log_dist(f"deepspeed_tpu info: version={__version__}", ranks=[0])
+
+    if model is None:
+        raise AssertionError("deepspeed.initialize requires a model")
+
+    if config is None:
+        config = config_params
+    if config is None and args is not None and hasattr(args, "deepspeed_config") and args.deepspeed_config is not None:
+        config = args.deepspeed_config
+    if config is None:
+        config = {}
+
+    if dist_init_required is None or dist_init_required:
+        init_distributed(dist_backend=get_accelerator().communication_backend_name())
+
+    ds_config = DeepSpeedConfig(config, mpu)
+
+    from deepspeed_tpu.pipe import PipelineModule
+
+    if hasattr(ds_config, "hybrid_engine") and ds_config.hybrid_engine.enabled:
+        from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedHybridEngine
+
+        engine = DeepSpeedHybridEngine(
+            args=args,
+            model=model,
+            optimizer=optimizer,
+            model_parameters=model_parameters,
+            training_data=training_data,
+            lr_scheduler=lr_scheduler,
+            mpu=mpu,
+            dist_init_required=dist_init_required,
+            collate_fn=collate_fn,
+            config=config,
+            config_class=ds_config,
+            loss_fn=loss_fn,
+        )
+    elif isinstance(model, PipelineModule):
+        from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
+
+        engine = PipelineEngine(
+            args=args,
+            model=model,
+            optimizer=optimizer,
+            model_parameters=model_parameters,
+            training_data=training_data,
+            lr_scheduler=lr_scheduler,
+            mpu=model.mpu() if hasattr(model, "mpu") else mpu,
+            dist_init_required=dist_init_required,
+            collate_fn=collate_fn,
+            config=config,
+            config_class=ds_config,
+            loss_fn=loss_fn,
+        )
+    else:
+        from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+        engine = DeepSpeedEngine(
+            args=args,
+            model=model,
+            optimizer=optimizer,
+            model_parameters=model_parameters,
+            training_data=training_data,
+            lr_scheduler=lr_scheduler,
+            mpu=mpu,
+            dist_init_required=dist_init_required,
+            collate_fn=collate_fn,
+            config=config,
+            config_class=ds_config,
+            loss_fn=loss_fn,
+        )
+
+    return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
+
+
+def add_config_arguments(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
+    """Add ``--deepspeed`` / ``--deepspeed_config`` CLI args (reference :205-243)."""
+    group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")
+    group.add_argument(
+        "--deepspeed",
+        default=False,
+        action="store_true",
+        help="Enable DeepSpeed (helper flag to easily toggle).",
+    )
+    group.add_argument("--deepspeed_config", default=None, type=str, help="DeepSpeed json config file.")
+    group.add_argument(
+        "--deepscale",
+        default=False,
+        action="store_true",
+        help=argparse.SUPPRESS,
+    )
+    group.add_argument("--deepscale_config", default=None, type=str, help=argparse.SUPPRESS)
+    return parser
+
+
+def default_inference_config():
+    """Default inference config dict (reference :262)."""
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+
+    return DeepSpeedInferenceConfig().model_dump()
+
+
+def init_inference(model, config=None, **kwargs):
+    """Build an inference engine (reference :269)."""
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    from deepspeed_tpu.inference.engine import InferenceEngine
+
+    log_dist(f"deepspeed_tpu inference info: version={__version__}", ranks=[0])
+    if config is None:
+        config = {}
+    if isinstance(config, DeepSpeedInferenceConfig):
+        ds_inference_config = config
+    else:
+        config_dict = dict(config)
+        config_dict.update(kwargs)
+        ds_inference_config = DeepSpeedInferenceConfig(**config_dict)
+    return InferenceEngine(model, config=ds_inference_config)
+
+
+# namespaces mirroring the reference exports
+from deepspeed_tpu import ops  # noqa: E402
+from deepspeed_tpu import zero  # noqa: E402
+from deepspeed_tpu.runtime import lr_schedules  # noqa: E402
+from deepspeed_tpu.pipe import PipelineModule  # noqa: E402
+from deepspeed_tpu.runtime.module import DSModule  # noqa: E402

@@ -1,0 +1,9 @@
+from .abstract_accelerator import DeepSpeedAccelerator
+from .real_accelerator import get_accelerator, set_accelerator, is_current_accelerator_supported
+
+__all__ = [
+    "DeepSpeedAccelerator",
+    "get_accelerator",
+    "set_accelerator",
+    "is_current_accelerator_supported",
+]

@@ -1,0 +1,226 @@
+"""Accelerator abstraction.
+
+TPU-native counterpart of the reference's ``accelerator/abstract_accelerator.py:10``
+(``DeepSpeedAccelerator`` ABC, ~60 methods). The surface is preserved where it is
+meaningful on XLA devices; CUDA-stream notions map onto JAX's async dispatch
+(streams are no-ops that preserve the call protocol), and op-builder dispatch
+resolves Pallas/XLA-backed builders instead of nvcc extensions.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Dict, Optional
+
+
+class DeepSpeedAccelerator(abc.ABC):
+    def __init__(self):
+        self._name: str = ""
+        self._communication_backend_name: str = ""
+
+    # --- device APIs ---------------------------------------------------
+    @abc.abstractmethod
+    def is_synchronized_device(self) -> bool:
+        ...
+
+    @abc.abstractmethod
+    def device_name(self, device_index: Optional[int] = None) -> str:
+        ...
+
+    @abc.abstractmethod
+    def device(self, device_index: Optional[int] = None):
+        ...
+
+    @abc.abstractmethod
+    def set_device(self, device_index: int) -> None:
+        ...
+
+    @abc.abstractmethod
+    def current_device(self) -> int:
+        ...
+
+    @abc.abstractmethod
+    def current_device_name(self) -> str:
+        ...
+
+    @abc.abstractmethod
+    def device_count(self) -> int:
+        ...
+
+    @abc.abstractmethod
+    def synchronize(self, device_index: Optional[int] = None) -> None:
+        ...
+
+    # --- RNG APIs ------------------------------------------------------
+    @abc.abstractmethod
+    def random(self):
+        ...
+
+    @abc.abstractmethod
+    def set_rng_state(self, new_state, device_index: Optional[int] = None) -> None:
+        ...
+
+    @abc.abstractmethod
+    def get_rng_state(self, device_index: Optional[int] = None):
+        ...
+
+    @abc.abstractmethod
+    def manual_seed(self, seed: int) -> None:
+        ...
+
+    @abc.abstractmethod
+    def initial_seed(self) -> int:
+        ...
+
+    @abc.abstractmethod
+    def default_generator(self, device_index: int):
+        ...
+
+    # --- streams / events ---------------------------------------------
+    @abc.abstractmethod
+    def Stream(self, *args, **kwargs):
+        ...
+
+    @abc.abstractmethod
+    def stream(self, stream):
+        ...
+
+    @abc.abstractmethod
+    def current_stream(self, device_index: Optional[int] = None):
+        ...
+
+    @abc.abstractmethod
+    def default_stream(self, device_index: Optional[int] = None):
+        ...
+
+    @abc.abstractmethod
+    def Event(self, **kwargs):
+        ...
+
+    # --- memory management ---------------------------------------------
+    @abc.abstractmethod
+    def empty_cache(self) -> None:
+        ...
+
+    @abc.abstractmethod
+    def memory_allocated(self, device_index: Optional[int] = None) -> int:
+        ...
+
+    @abc.abstractmethod
+    def max_memory_allocated(self, device_index: Optional[int] = None) -> int:
+        ...
+
+    @abc.abstractmethod
+    def reset_max_memory_allocated(self, device_index: Optional[int] = None) -> None:
+        ...
+
+    @abc.abstractmethod
+    def memory_reserved(self, device_index: Optional[int] = None) -> int:
+        ...
+
+    @abc.abstractmethod
+    def max_memory_reserved(self, device_index: Optional[int] = None) -> int:
+        ...
+
+    @abc.abstractmethod
+    def total_memory(self, device_index: Optional[int] = None) -> int:
+        ...
+
+    @abc.abstractmethod
+    def available_memory(self, device_index: Optional[int] = None) -> int:
+        ...
+
+    def memory_stats(self, device_index: Optional[int] = None) -> Dict[str, Any]:
+        return {}
+
+    def reset_peak_memory_stats(self, device_index: Optional[int] = None) -> None:
+        pass
+
+    # --- dtype support --------------------------------------------------
+    @abc.abstractmethod
+    def is_bf16_supported(self) -> bool:
+        ...
+
+    @abc.abstractmethod
+    def is_fp16_supported(self) -> bool:
+        ...
+
+    @abc.abstractmethod
+    def supported_dtypes(self) -> list:
+        ...
+
+    # --- misc ----------------------------------------------------------
+    @abc.abstractmethod
+    def amp(self):
+        ...
+
+    @abc.abstractmethod
+    def is_available(self) -> bool:
+        ...
+
+    @abc.abstractmethod
+    def range_push(self, msg: str):
+        ...
+
+    @abc.abstractmethod
+    def range_pop(self):
+        ...
+
+    @abc.abstractmethod
+    def lazy_call(self, callback):
+        ...
+
+    @abc.abstractmethod
+    def communication_backend_name(self) -> str:
+        ...
+
+    @abc.abstractmethod
+    def is_triton_supported(self) -> bool:
+        ...
+
+    # --- graph capture (maps to jax.jit compilation cache) -------------
+    @abc.abstractmethod
+    def create_graph(self):
+        ...
+
+    @abc.abstractmethod
+    def capture_to_graph(self, graph, pool=None, stream=None):
+        ...
+
+    @abc.abstractmethod
+    def replay_graph(self, graph):
+        ...
+
+    # --- tensor/array namespace ops -------------------------------------
+    @abc.abstractmethod
+    def pin_memory(self, tensor, align_bytes: int = 1):
+        ...
+
+    @abc.abstractmethod
+    def is_pinned(self, tensor) -> bool:
+        ...
+
+    @abc.abstractmethod
+    def on_accelerator(self, tensor) -> bool:
+        ...
+
+    # --- op builder dispatch --------------------------------------------
+    @abc.abstractmethod
+    def op_builder_dir(self) -> str:
+        ...
+
+    @abc.abstractmethod
+    def create_op_builder(self, op_name: str):
+        ...
+
+    @abc.abstractmethod
+    def get_op_builder(self, op_name: str):
+        ...
+
+    @abc.abstractmethod
+    def build_extension(self):
+        ...
+
+    @abc.abstractmethod
+    def export_envs(self) -> list:
+        ...

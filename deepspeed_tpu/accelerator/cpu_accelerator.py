@@ -1,0 +1,48 @@
+"""CPU accelerator (JAX cpu platform) — used by the test harness.
+
+Counterpart of the reference's ``accelerator/cpu_accelerator.py``. Identical to
+the TPU accelerator except for naming: JAX's cpu platform runs the same XLA
+programs, which is how the multi-chip sharding tests execute on a virtual
+8-device CPU mesh.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .tpu_accelerator import TPU_Accelerator
+
+
+class CPU_Accelerator(TPU_Accelerator):
+    def __init__(self):
+        super().__init__()
+        self._name = "cpu"
+        self._communication_backend_name = "xla"
+
+    def device_name(self, device_index: Optional[int] = None) -> str:
+        if device_index is None:
+            return "cpu"
+        return f"cpu:{device_index}"
+
+    def current_device_name(self) -> str:
+        return f"cpu:{self._current_device_index}"
+
+    def is_bf16_supported(self) -> bool:
+        return True
+
+    def is_fp16_supported(self) -> bool:
+        return True
+
+    def total_memory(self, device_index: Optional[int] = None) -> int:
+        stats = self._memory_stats(device_index)
+        if "bytes_limit" in stats:
+            return int(stats["bytes_limit"])
+        try:
+            import psutil  # pragma: no cover - optional
+
+            return int(psutil.virtual_memory().total)
+        except Exception:
+            return 0
+
+    def export_envs(self) -> List[str]:
+        return ["JAX", "XLA"]

@@ -1,0 +1,58 @@
+"""Accelerator selection.
+
+Counterpart of the reference's ``accelerator/real_accelerator.py:45-140``:
+``get_accelerator()`` singleton honoring the ``DS_ACCELERATOR`` env var, else
+probing the JAX backend (tpu/axon → TPU accelerator, otherwise CPU).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .abstract_accelerator import DeepSpeedAccelerator
+
+_accelerator: Optional[DeepSpeedAccelerator] = None
+
+_TPU_PLATFORMS = ("tpu", "axon")
+
+
+def _detect_platform() -> str:
+    override = os.environ.get("DS_ACCELERATOR")
+    if override:
+        return override.lower()
+    try:
+        import jax
+
+        platform = jax.devices()[0].platform
+        return "tpu" if platform in _TPU_PLATFORMS else "cpu"
+    except Exception:
+        return "cpu"
+
+
+def get_accelerator() -> DeepSpeedAccelerator:
+    global _accelerator
+    if _accelerator is None:
+        name = _detect_platform()
+        if name == "tpu":
+            from .tpu_accelerator import TPU_Accelerator
+
+            _accelerator = TPU_Accelerator()
+        elif name == "cpu":
+            from .cpu_accelerator import CPU_Accelerator
+
+            _accelerator = CPU_Accelerator()
+        else:
+            raise ValueError(
+                f"DS_ACCELERATOR={name!r} is not supported by the TPU build (expected 'tpu' or 'cpu')"
+            )
+    return _accelerator
+
+
+def set_accelerator(accel: DeepSpeedAccelerator) -> None:
+    global _accelerator
+    _accelerator = accel
+
+
+def is_current_accelerator_supported() -> bool:
+    return _detect_platform() in ("tpu", "cpu")

@@ -1,0 +1,291 @@
+"""TPU implementation of the accelerator ABC.
+
+Counterpart of the reference's ``accelerator/cuda_accelerator.py`` selected via
+``accelerator/real_accelerator.py:45-140``. Device handles are ``jax.Device``
+objects; memory stats come from the platform allocator
+(``jax.Device.memory_stats()``); "streams" are thin objects that preserve the
+call protocol — JAX dispatch is already asynchronous and ordered, so entering a
+stream is a no-op and ``synchronize`` blocks on all outstanding work via
+``jax.block_until_ready`` of a trivial computation barrier.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .abstract_accelerator import DeepSpeedAccelerator
+
+
+class _NoOpStream:
+    """Stream stand-in: XLA orders work for us; kept for API parity."""
+
+    def __init__(self, device=None):
+        self.device = device
+
+    def synchronize(self):
+        from deepspeed_tpu.utils.sync import device_sync
+
+        device_sync()
+
+    def wait_stream(self, other):  # noqa: ARG002
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class _NoOpEvent:
+    def __init__(self, enable_timing: bool = False, **kwargs):
+        self.enable_timing = enable_timing
+        self._time: Optional[float] = None
+
+    def record(self, stream=None):  # noqa: ARG002
+        import time
+
+        self._time = time.perf_counter()
+
+    def synchronize(self):
+        pass
+
+    def wait(self, stream=None):  # noqa: ARG002
+        pass
+
+    def query(self) -> bool:
+        return True
+
+    def elapsed_time(self, end_event: "_NoOpEvent") -> float:
+        if self._time is None or end_event._time is None:
+            return 0.0
+        return (end_event._time - self._time) * 1000.0
+
+
+class TPU_Accelerator(DeepSpeedAccelerator):
+    def __init__(self):
+        super().__init__()
+        self._name = "tpu"
+        self._communication_backend_name = "xla"
+        self._current_device_index = 0
+        self._seed = 42
+        self._rng_key = None
+
+    def _jax(self):
+        import jax
+
+        return jax
+
+    def _devices(self):
+        return self._jax().devices()
+
+    # --- device APIs ---------------------------------------------------
+    def is_synchronized_device(self) -> bool:
+        return False
+
+    def device_name(self, device_index: Optional[int] = None) -> str:
+        if device_index is None:
+            return "tpu"
+        return f"tpu:{device_index}"
+
+    def device(self, device_index: Optional[int] = None):
+        devs = self._devices()
+        return devs[device_index if device_index is not None else self._current_device_index]
+
+    def set_device(self, device_index: int) -> None:
+        self._current_device_index = device_index
+
+    def current_device(self) -> int:
+        return self._current_device_index
+
+    def current_device_name(self) -> str:
+        return f"tpu:{self._current_device_index}"
+
+    def device_count(self) -> int:
+        return len(self._devices())
+
+    def synchronize(self, device_index: Optional[int] = None) -> None:  # noqa: ARG002
+        from deepspeed_tpu.utils.sync import device_sync
+
+        device_sync()
+
+    # --- RNG APIs ------------------------------------------------------
+    def random(self):
+        import jax
+
+        return jax.random
+
+    def _key(self):
+        import jax
+
+        if self._rng_key is None:
+            self._rng_key = jax.random.PRNGKey(self._seed)
+        return self._rng_key
+
+    def set_rng_state(self, new_state, device_index: Optional[int] = None) -> None:  # noqa: ARG002
+        self._rng_key = new_state
+
+    def get_rng_state(self, device_index: Optional[int] = None):  # noqa: ARG002
+        return self._key()
+
+    def manual_seed(self, seed: int) -> None:
+        import jax
+
+        self._seed = int(seed)
+        self._rng_key = jax.random.PRNGKey(self._seed)
+
+    def manual_seed_all(self, seed: int) -> None:
+        self.manual_seed(seed)
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def default_generator(self, device_index: int):  # noqa: ARG002
+        return self._key()
+
+    # --- streams / events ----------------------------------------------
+    def Stream(self, *args, **kwargs):
+        return _NoOpStream(*args, **kwargs)
+
+    def stream(self, stream):
+        return contextlib.nullcontext(stream)
+
+    def current_stream(self, device_index: Optional[int] = None):
+        return _NoOpStream(device_index)
+
+    def default_stream(self, device_index: Optional[int] = None):
+        return _NoOpStream(device_index)
+
+    def Event(self, **kwargs):
+        return _NoOpEvent(**kwargs)
+
+    # --- memory management ---------------------------------------------
+    def _memory_stats(self, device_index: Optional[int] = None) -> Dict[str, Any]:
+        try:
+            stats = self.device(device_index).memory_stats()
+            return stats or {}
+        except Exception:
+            return {}
+
+    def empty_cache(self) -> None:
+        pass
+
+    def memory_allocated(self, device_index: Optional[int] = None) -> int:
+        return int(self._memory_stats(device_index).get("bytes_in_use", 0))
+
+    def max_memory_allocated(self, device_index: Optional[int] = None) -> int:
+        return int(self._memory_stats(device_index).get("peak_bytes_in_use", 0))
+
+    def reset_max_memory_allocated(self, device_index: Optional[int] = None) -> None:
+        pass
+
+    def memory_reserved(self, device_index: Optional[int] = None) -> int:
+        return int(self._memory_stats(device_index).get("bytes_reserved", self.memory_allocated(device_index)))
+
+    def max_memory_reserved(self, device_index: Optional[int] = None) -> int:
+        return self.max_memory_allocated(device_index)
+
+    def total_memory(self, device_index: Optional[int] = None) -> int:
+        return int(self._memory_stats(device_index).get("bytes_limit", 0))
+
+    def available_memory(self, device_index: Optional[int] = None) -> int:
+        return self.total_memory(device_index) - self.memory_allocated(device_index)
+
+    def memory_stats(self, device_index: Optional[int] = None) -> Dict[str, Any]:
+        return self._memory_stats(device_index)
+
+    # --- dtype support --------------------------------------------------
+    def is_bf16_supported(self) -> bool:
+        return True
+
+    def is_fp16_supported(self) -> bool:
+        # fp16 works on TPU but bf16 is the native fast path.
+        return True
+
+    def supported_dtypes(self) -> List[Any]:
+        import jax.numpy as jnp
+
+        return [jnp.float32, jnp.bfloat16, jnp.float16, jnp.int8, jnp.int32]
+
+    # --- misc ----------------------------------------------------------
+    def amp(self):
+        return None
+
+    def is_available(self) -> bool:
+        try:
+            return len(self._devices()) > 0
+        except Exception:
+            return False
+
+    def range_push(self, msg: str):
+        import jax.profiler
+
+        ctx = jax.profiler.TraceAnnotation(msg)
+        ctx.__enter__()
+        self._range_stack = getattr(self, "_range_stack", [])
+        self._range_stack.append(ctx)
+
+    def range_pop(self):
+        stack = getattr(self, "_range_stack", [])
+        if stack:
+            stack.pop().__exit__(None, None, None)
+
+    def lazy_call(self, callback):
+        callback()
+
+    def communication_backend_name(self) -> str:
+        return self._communication_backend_name
+
+    def is_triton_supported(self) -> bool:
+        return False
+
+    # --- graph capture: maps to jit compile cache -----------------------
+    def create_graph(self):
+        return None
+
+    def capture_to_graph(self, graph, pool=None, stream=None):  # noqa: ARG002
+        return contextlib.nullcontext()
+
+    def replay_graph(self, graph):  # noqa: ARG002
+        pass
+
+    # --- host-memory ops -------------------------------------------------
+    def pin_memory(self, tensor, align_bytes: int = 1):  # noqa: ARG002
+        # numpy arrays on the TPU-VM host are DMA-able as-is.
+        return np.ascontiguousarray(tensor) if isinstance(tensor, np.ndarray) else tensor
+
+    def is_pinned(self, tensor) -> bool:  # noqa: ARG002
+        return True
+
+    def on_accelerator(self, tensor) -> bool:
+        try:
+            import jax
+
+            if isinstance(tensor, jax.Array):
+                return all(d.platform != "cpu" for d in tensor.devices())
+        except Exception:
+            pass
+        return False
+
+    # --- op builder dispatch ---------------------------------------------
+    def op_builder_dir(self) -> str:
+        return "deepspeed_tpu.ops.op_builder"
+
+    def create_op_builder(self, op_name: str):
+        builder_cls = self.get_op_builder(op_name)
+        return builder_cls() if builder_cls is not None else None
+
+    def get_op_builder(self, op_name: str):
+        from deepspeed_tpu.ops.op_builder import get_builder
+
+        return get_builder(op_name)
+
+    def build_extension(self):
+        return None
+
+    def export_envs(self) -> List[str]:
+        return ["JAX", "XLA", "LIBTPU", "TPU"]

@@ -1,0 +1,102 @@
+"""Inference config (reference: ``deepspeed/inference/config.py``, 304 LoC)."""
+
+from __future__ import annotations
+
+from enum import Enum
+from typing import Any, Dict, Optional
+
+from pydantic import Field, model_validator
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+
+class DtypeEnum(str, Enum):
+    fp32 = "fp32"
+    fp16 = "fp16"
+    bf16 = "bf16"
+    int8 = "int8"
+
+
+class DeepSpeedTPConfig(DeepSpeedConfigModel):
+    enabled: bool = True
+    tp_size: int = 1
+    mpu: Optional[Any] = None
+    tp_group: Optional[Any] = None
+
+
+class DeepSpeedMoEConfig(DeepSpeedConfigModel):
+    enabled: bool = True
+    ep_size: int = 1
+    moe_experts: list = Field(default_factory=lambda: [1])
+    type: str = "standard"
+
+
+class QuantizationConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    num_bits: int = 8
+    group_size: int = 64
+
+
+class BaseQuantConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    num_bits: int = 8
+    group_size: int = 64
+
+
+class WeightQuantConfig(BaseQuantConfig):
+    pass
+
+
+class ActivationQuantConfig(BaseQuantConfig):
+    pass
+
+
+class QKVQuantConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+
+
+class CheckpointConfig(DeepSpeedConfigModel):
+    checkpoint_dir: Optional[str] = None
+    save_mp_checkpoint_path: Optional[str] = None
+    base_dir: Optional[str] = None
+
+
+class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
+    replace_with_kernel_inject: bool = Field(False, alias="kernel_inject")
+    dtype: DtypeEnum = DtypeEnum.bf16
+    tensor_parallel: DeepSpeedTPConfig = Field(default_factory=DeepSpeedTPConfig, alias="tp")
+    enable_cuda_graph: bool = False  # parity flag; maps to jit compile cache
+    use_triton: bool = False
+    triton_autotune: bool = False
+    zero: Dict[str, Any] = Field(default_factory=dict)
+    triangular_masking: bool = Field(True, alias="tm")
+    moe: DeepSpeedMoEConfig = Field(default_factory=DeepSpeedMoEConfig)
+    quant: QuantizationConfig = Field(default_factory=QuantizationConfig)
+    checkpoint: Optional[Any] = None
+    base_dir: str = ""
+    set_empty_params: bool = False
+    save_mp_checkpoint_path: Optional[str] = None
+    checkpoint_config: CheckpointConfig = Field(default_factory=CheckpointConfig, alias="ckpt_config")
+    return_tuple: bool = True
+    training_mp_size: int = 1
+    replace_method: str = "auto"
+    injection_policy: Optional[Dict] = Field(None, alias="injection_dict")
+    injection_policy_tuple: Optional[tuple] = None
+    config: Optional[Dict] = None
+    max_out_tokens: int = Field(1024, alias="max_tokens")
+    min_out_tokens: int = Field(1, alias="min_tokens")
+    transposed_mode: bool = False
+    ep_size: int = 1
+    ep_group: Optional[Any] = Field(None, alias="expert_group")
+    ep_mp_group: Optional[Any] = Field(None, alias="expert_mp_group")
+    moe_experts: list = Field(default_factory=lambda: [1])
+    moe_type: str = "standard"
+
+    @model_validator(mode="before")
+    @classmethod
+    def _legacy_mp_size(cls, values):
+        """Reference's deprecated ``mp_size`` maps onto tensor_parallel.tp_size."""
+        if isinstance(values, dict) and "mp_size" in values:
+            mp = values.pop("mp_size")
+            values.setdefault("tensor_parallel", {"tp_size": mp})
+        return values

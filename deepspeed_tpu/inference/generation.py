@@ -1,0 +1,64 @@
+"""Greedy decoding with a single compiled program.
+
+The naive loop regrows the token array each step, recompiling per length.
+Here the sequence is padded once to ``prompt_len + max_new_tokens`` and a
+jitted step reads the logits at a *traced* cursor and writes the next token
+in place (``dynamic_update_slice``), so XLA compiles exactly one program per
+(batch, max_len) bucket. Causality makes the padding harmless: positions
+≥ cursor cannot influence the logits at cursor-1 in a causal model.
+
+This is the interim decode path; the paged KV-cache attention kernel replaces
+the full-sequence forward for long generations.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def greedy_generate(
+    apply_fn: Callable,  # (params, tokens[B,L], rng) -> logits[B,L,V]
+    params,
+    input_ids,
+    max_new_tokens: int,
+    rng,
+    eos_token_id: Optional[int] = None,
+    pad_token_id: int = 0,
+    jit_cache: Optional[dict] = None,
+):
+    tokens = jnp.asarray(input_ids)
+    if tokens.ndim == 1:
+        tokens = tokens[None, :]
+    batch, prompt_len = tokens.shape
+    max_len = prompt_len + max_new_tokens
+    padded = jnp.full((batch, max_len), pad_token_id, dtype=tokens.dtype)
+    padded = jax.lax.dynamic_update_slice(padded, tokens, (0, 0))
+
+    cache_key = ("greedy_step", batch, max_len)
+    if jit_cache is not None and cache_key in jit_cache:
+        step = jit_cache[cache_key]
+    else:
+
+        def _step(params, padded, cursor, rng):
+            logits = apply_fn(params, padded, rng)
+            last = jax.lax.dynamic_index_in_dim(logits, cursor - 1, axis=1, keepdims=False)
+            next_tok = jnp.argmax(last, axis=-1).astype(padded.dtype)
+            out = jax.lax.dynamic_update_slice(padded, next_tok[:, None], (0, cursor))
+            return out, next_tok
+
+        step = jax.jit(_step, donate_argnums=(1,))
+        if jit_cache is not None:
+            jit_cache[cache_key] = step
+
+    cursor = prompt_len
+    for _ in range(max_new_tokens):
+        rng, sub = jax.random.split(rng)
+        padded, next_tok = step(params, padded, jnp.int32(cursor), sub)
+        cursor += 1
+        if eos_token_id is not None and bool(np.all(jax.device_get(next_tok) == eos_token_id)):
+            break
+    return padded[:, :cursor]

@@ -1,0 +1,107 @@
+"""Monitoring backends.
+
+Counterpart of ``deepspeed/monitor/`` (``MonitorMaster`` monitor.py:29 fanning
+out ``write_events`` to TensorBoard / W&B / CSV).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Tuple
+
+from deepspeed_tpu.comm import comm as dist
+from deepspeed_tpu.utils.logging import logger
+
+
+class Monitor:
+    def __init__(self, monitor_config):
+        self.monitor_config = monitor_config
+        self.enabled = False
+
+    def write_events(self, event_list: List[Tuple]) -> None:
+        raise NotImplementedError
+
+
+class TensorBoardMonitor(Monitor):
+    def __init__(self, tensorboard_config):
+        super().__init__(tensorboard_config)
+        self.summary_writer = None
+        self.enabled = tensorboard_config.enabled and dist.get_rank() == 0
+        if self.enabled:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                log_dir = os.path.join(tensorboard_config.output_path or "./runs", tensorboard_config.job_name)
+                self.summary_writer = SummaryWriter(log_dir=log_dir)
+            except ImportError:
+                logger.warning("tensorboard not available; disabling TensorBoardMonitor")
+                self.enabled = False
+
+    def write_events(self, event_list, flush: bool = True) -> None:
+        if not self.enabled or self.summary_writer is None:
+            return
+        for name, value, step in event_list:
+            self.summary_writer.add_scalar(name, value, step)
+        if flush:
+            self.summary_writer.flush()
+
+
+class WandbMonitor(Monitor):
+    def __init__(self, wandb_config):
+        super().__init__(wandb_config)
+        self.enabled = wandb_config.enabled and dist.get_rank() == 0
+        if self.enabled:
+            try:
+                import wandb
+
+                self._wandb = wandb
+                wandb.init(project=wandb_config.project, group=wandb_config.group, entity=wandb_config.team)
+            except ImportError:
+                logger.warning("wandb not available; disabling WandbMonitor")
+                self.enabled = False
+
+    def write_events(self, event_list) -> None:
+        if not self.enabled:
+            return
+        for name, value, step in event_list:
+            self._wandb.log({name: value}, step=step)
+
+
+class csvMonitor(Monitor):
+    def __init__(self, csv_config):
+        super().__init__(csv_config)
+        self.enabled = csv_config.enabled and dist.get_rank() == 0
+        self.filenames = {}
+        self.output_path = csv_config.output_path or "./csv_monitor"
+        self.job_name = csv_config.job_name
+        if self.enabled:
+            os.makedirs(os.path.join(self.output_path, self.job_name), exist_ok=True)
+
+    def write_events(self, event_list) -> None:
+        if not self.enabled:
+            return
+        import csv
+
+        for name, value, step in event_list:
+            safe = name.replace("/", "_")
+            fname = os.path.join(self.output_path, self.job_name, f"{safe}.csv")
+            new = not os.path.exists(fname)
+            with open(fname, "a", newline="") as f:
+                w = csv.writer(f)
+                if new:
+                    w.writerow(["step", safe])
+                w.writerow([step, value])
+
+
+class MonitorMaster(Monitor):
+    def __init__(self, monitor_config):
+        super().__init__(monitor_config)
+        self.tb_monitor = TensorBoardMonitor(monitor_config.tensorboard)
+        self.wandb_monitor = WandbMonitor(monitor_config.wandb)
+        self.csv_monitor = csvMonitor(monitor_config.csv_monitor)
+        self.enabled = self.tb_monitor.enabled or self.wandb_monitor.enabled or self.csv_monitor.enabled
+
+    def write_events(self, event_list) -> None:
+        for m in (self.tb_monitor, self.wandb_monitor, self.csv_monitor):
+            if m.enabled:
+                m.write_events(event_list)

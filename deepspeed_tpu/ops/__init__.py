@@ -1,0 +1,9 @@
+from deepspeed_tpu.ops import op_builder
+from deepspeed_tpu.ops.adam.fused_adam import Adam, AdamW, FusedAdam
+from deepspeed_tpu.ops.adagrad.cpu_adagrad import DeepSpeedCPUAdagrad
+from deepspeed_tpu.ops.lamb.fused_lamb import FusedLamb
+from deepspeed_tpu.ops.sgd import SGD
+
+# reference exposes DeepSpeedCPUAdam; the host-offload variant shares FusedAdam
+# math and is selected by the ZeRO offload config. Alias for API parity.
+DeepSpeedCPUAdam = FusedAdam

@@ -1,0 +1,57 @@
+"""Adagrad (reference: ``deepspeed/ops/adagrad/cpu_adagrad.py`` +
+``csrc/adagrad/cpu_adagrad.cpp``).
+
+The in-jit variant lives here; the true host-offloaded (C++/AVX) path is in
+``deepspeed_tpu/ops/host_optimizer`` and shares this math.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.optimizer import DSOptimizer
+
+
+class AdagradState(NamedTuple):
+    step: jax.Array
+    sum_sq: Any
+
+
+class DeepSpeedCPUAdagrad(DSOptimizer):
+    def __init__(self, params=None, lr: float = 1e-2, eps: float = 1e-10, weight_decay: float = 0.0):  # noqa: ARG002
+        super().__init__(lr=lr, weight_decay=weight_decay, eps=eps)
+
+    def init_state(self, params: Any) -> AdagradState:
+        return AdagradState(
+            step=jnp.zeros((), jnp.int32),
+            sum_sq=jax.tree_util.tree_map(lambda p: jnp.zeros(jnp.shape(p), jnp.float32), params),
+        )
+
+    def state_specs(self, param_specs: Any) -> "AdagradState":
+        from jax.sharding import PartitionSpec
+
+        return AdagradState(step=PartitionSpec(), sum_sq=param_specs)
+
+    def apply(self, grads: Any, state: AdagradState, params: Any, lr) -> Tuple[Any, AdagradState]:
+        eps = self.defaults["eps"]
+        wd = self.defaults["weight_decay"]
+
+        def leaf(p, g, s):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            if wd:
+                g = g + wd * p32
+            s = s + g * g
+            return (p32 - lr * g / (jnp.sqrt(s) + eps)).astype(p.dtype), s
+
+        flat_p, treedef = jax.tree_util.tree_flatten(params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_s = treedef.flatten_up_to(state.sum_sq)
+        out = [leaf(p, g, s) for p, g, s in zip(flat_p, flat_g, flat_s)]
+        return (
+            treedef.unflatten([o[0] for o in out]),
+            AdagradState(state.step + 1, treedef.unflatten([o[1] for o in out])),
+        )

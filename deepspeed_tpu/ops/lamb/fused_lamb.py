@@ -1,0 +1,86 @@
+"""FusedLamb.
+
+Counterpart of ``deepspeed/ops/lamb/fused_lamb.py`` +
+``csrc/lamb/fused_lamb_cuda_kernel.cu``: LAMB with per-layer trust ratio. One
+jitted pass; per-leaf norms are small reductions XLA fuses into the update.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.optimizer import DSOptimizer
+
+
+class LambState(NamedTuple):
+    step: jax.Array
+    exp_avg: Any
+    exp_avg_sq: Any
+
+
+class FusedLamb(DSOptimizer):
+    def __init__(
+        self,
+        params=None,  # noqa: ARG002
+        lr: float = 1e-3,
+        bias_correction: bool = True,
+        betas: Tuple[float, float] = (0.9, 0.999),
+        eps: float = 1e-8,
+        weight_decay: float = 0.0,
+        max_grad_norm: float = 0.0,  # noqa: ARG002 - clipping handled by engine
+        max_coeff: float = 10.0,
+        min_coeff: float = 0.01,
+        amsgrad: bool = False,
+    ):
+        if amsgrad:
+            raise ValueError("FusedLamb does not support amsgrad")
+        super().__init__(lr=lr, weight_decay=weight_decay, betas=betas, eps=eps)
+        self.bias_correction = bias_correction
+        self.max_coeff = max_coeff
+        self.min_coeff = min_coeff
+
+    def init_state(self, params: Any) -> LambState:
+        z = lambda: jax.tree_util.tree_map(lambda p: jnp.zeros(jnp.shape(p), jnp.float32), params)
+        return LambState(step=jnp.zeros((), jnp.int32), exp_avg=z(), exp_avg_sq=z())
+
+    def state_specs(self, param_specs: Any) -> "LambState":
+        from jax.sharding import PartitionSpec
+
+        return LambState(step=PartitionSpec(), exp_avg=param_specs, exp_avg_sq=param_specs)
+
+    def apply(self, grads: Any, state: LambState, params: Any, lr) -> Tuple[Any, LambState]:
+        beta1, beta2 = self.defaults["betas"]
+        eps = self.defaults["eps"]
+        wd = self.defaults["weight_decay"]
+        step = state.step + 1
+        stepf = step.astype(jnp.float32)
+        bc1 = 1.0 - beta1**stepf if self.bias_correction else jnp.float32(1.0)
+        bc2 = 1.0 - beta2**stepf if self.bias_correction else jnp.float32(1.0)
+
+        def leaf(p, g, m, v):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            m = beta1 * m + (1.0 - beta1) * g
+            v = beta2 * v + (1.0 - beta2) * (g * g)
+            update = (m / bc1) / (jnp.sqrt(v / bc2) + eps) + wd * p32
+            w_norm = jnp.linalg.norm(p32.reshape(-1))
+            u_norm = jnp.linalg.norm(update.reshape(-1))
+            trust = jnp.where(
+                (w_norm > 0) & (u_norm > 0),
+                jnp.clip(w_norm / u_norm, self.min_coeff, self.max_coeff),
+                1.0,
+            )
+            return (p32 - lr * trust * update).astype(p.dtype), m, v
+
+        flat_p, treedef = jax.tree_util.tree_flatten(params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_m = treedef.flatten_up_to(state.exp_avg)
+        flat_v = treedef.flatten_up_to(state.exp_avg_sq)
+        out = [leaf(p, g, m, v) for p, g, m, v in zip(flat_p, flat_g, flat_m, flat_v)]
+        return (
+            treedef.unflatten([o[0] for o in out]),
+            LambState(step, treedef.unflatten([o[1] for o in out]), treedef.unflatten([o[2] for o in out])),
+        )

@@ -1,0 +1,144 @@
+"""Op-builder registry.
+
+Counterpart of the reference's ``op_builder/`` tree (``OpBuilder`` ABC,
+builder.py:102). On TPU there is nothing to nvcc: "building" an op resolves a
+Pallas/XLA-backed implementation (always compatible), or compiles the C++ host
+library (CPUAdam / async IO) on first use. ``get_accelerator().get_op_builder``
+dispatches here (abstract_accelerator.py:233 pattern).
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class OpBuilder:
+    BUILD_VAR = "DS_BUILD_OPS"
+    NAME = "base"
+
+    def is_compatible(self, verbose: bool = True) -> bool:  # noqa: ARG002
+        return True
+
+    def load(self, verbose: bool = True):
+        """Return the op module (imports resolve Pallas/XLA implementations)."""
+        raise NotImplementedError
+
+    def builder(self):
+        return None
+
+    @property
+    def name(self) -> str:
+        return self.NAME
+
+
+class _ModuleOpBuilder(OpBuilder):
+    """Builder that resolves to a python module path on load."""
+
+    MODULE: str = ""
+
+    def load(self, verbose: bool = True):
+        if verbose:
+            logger.debug(f"Loading op {self.NAME} from {self.MODULE}")
+        return importlib.import_module(self.MODULE)
+
+
+class FusedAdamBuilder(_ModuleOpBuilder):
+    NAME = "fused_adam"
+    MODULE = "deepspeed_tpu.ops.adam.fused_adam"
+
+
+class CPUAdamBuilder(_ModuleOpBuilder):
+    NAME = "cpu_adam"
+    MODULE = "deepspeed_tpu.ops.host_optimizer"
+
+    def is_compatible(self, verbose: bool = True) -> bool:  # noqa: ARG002
+        try:
+            self.load(verbose=False)
+            return True
+        except Exception:
+            return False
+
+
+class CPUAdagradBuilder(_ModuleOpBuilder):
+    NAME = "cpu_adagrad"
+    MODULE = "deepspeed_tpu.ops.adagrad.cpu_adagrad"
+
+
+class FusedLambBuilder(_ModuleOpBuilder):
+    NAME = "fused_lamb"
+    MODULE = "deepspeed_tpu.ops.lamb.fused_lamb"
+
+
+class TransformerBuilder(_ModuleOpBuilder):
+    NAME = "transformer"
+    MODULE = "deepspeed_tpu.ops.transformer"
+
+
+class InferenceBuilder(_ModuleOpBuilder):
+    NAME = "transformer_inference"
+    MODULE = "deepspeed_tpu.ops.transformer.inference"
+
+
+class QuantizerBuilder(_ModuleOpBuilder):
+    NAME = "quantizer"
+    MODULE = "deepspeed_tpu.ops.quantizer"
+
+
+class SparseAttnBuilder(_ModuleOpBuilder):
+    NAME = "sparse_attn"
+    MODULE = "deepspeed_tpu.ops.sparse_attention"
+
+
+class RandomLTDBuilder(_ModuleOpBuilder):
+    NAME = "random_ltd"
+    MODULE = "deepspeed_tpu.ops.random_ltd"
+
+
+class SpatialInferenceBuilder(_ModuleOpBuilder):
+    NAME = "spatial_inference"
+    MODULE = "deepspeed_tpu.ops.spatial"
+
+
+class AsyncIOBuilder(_ModuleOpBuilder):
+    NAME = "async_io"
+    MODULE = "deepspeed_tpu.ops.aio"
+
+    def is_compatible(self, verbose: bool = True) -> bool:  # noqa: ARG002
+        try:
+            self.load(verbose=False)
+            return True
+        except Exception:
+            return False
+
+
+class UtilsBuilder(_ModuleOpBuilder):
+    NAME = "utils"
+    MODULE = "deepspeed_tpu.ops.flatten"
+
+
+_BUILDERS = {
+    cls.NAME: cls
+    for cls in (
+        FusedAdamBuilder,
+        CPUAdamBuilder,
+        CPUAdagradBuilder,
+        FusedLambBuilder,
+        TransformerBuilder,
+        InferenceBuilder,
+        QuantizerBuilder,
+        SparseAttnBuilder,
+        RandomLTDBuilder,
+        SpatialInferenceBuilder,
+        AsyncIOBuilder,
+        UtilsBuilder,
+    )
+}
+
+ALL_OPS = dict(_BUILDERS)
+
+
+def get_builder(op_name: str) -> Optional[type]:
+    return _BUILDERS.get(op_name)

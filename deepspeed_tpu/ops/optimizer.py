@@ -1,0 +1,62 @@
+"""Optimizer base.
+
+The reference's optimizers are CUDA multi-tensor-apply kernels behind
+torch.optim classes (``csrc/adam/multi_tensor_adam.cu``,
+``deepspeed/ops/adam/fused_adam.py``). On TPU an optimizer is a pair of pure
+functions — ``init_state(params)`` and ``apply(grads, state, params, lr)`` —
+that the engine jits *inside* the train step, so the whole update is one fused
+XLA program over the sharded master buffers: that is the multi-tensor-apply
+equivalent (one fused loop over every leaf, no per-param kernel launches).
+
+The class carries torch-style ``param_groups`` (a list of dicts with ``lr``
+etc.) because the reference's LR schedulers mutate ``param_groups[i]["lr"]``
+(``deepspeed/runtime/lr_schedules.py``) — the engine reads the group lr each
+step and feeds it to the jitted update as a traced scalar, so lr changes never
+trigger recompilation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+
+class DSOptimizer:
+    """Base: subclasses implement init_state / apply as pure functions."""
+
+    def __init__(self, lr: float, weight_decay: float = 0.0, **defaults):
+        self.defaults: Dict[str, Any] = {"lr": lr, "weight_decay": weight_decay, **defaults}
+        self.param_groups: List[Dict[str, Any]] = [dict(self.defaults)]
+
+    # --- torch-style surface -------------------------------------------
+    @property
+    def lr(self) -> float:
+        return self.param_groups[0]["lr"]
+
+    @lr.setter
+    def lr(self, value: float) -> None:
+        for g in self.param_groups:
+            g["lr"] = value
+
+    def get_lr(self) -> List[float]:
+        return [g["lr"] for g in self.param_groups]
+
+    # --- functional surface ---------------------------------------------
+    def init_state(self, params: Any) -> Any:
+        raise NotImplementedError
+
+    def apply(self, grads: Any, state: Any, params: Any, lr) -> Tuple[Any, Any]:
+        """Return (new_params, new_state). Must be jit-traceable."""
+        raise NotImplementedError
+
+    def state_specs(self, param_specs: Any) -> Any:
+        """PartitionSpec tree for the optimizer state, congruent with
+        ``init_state``'s output, given the master-param spec tree. ZeRO ≥ 1
+        shards the moments exactly like the master partitions
+        (stage_1_and_2.py ``initialize_optimizer_states`` :636)."""
+        raise NotImplementedError
+
+    def state_dict_shapes(self, params: Any) -> Any:
+        """Shapes/dtypes of the optimizer state (for checkpoint planning)."""
+        import jax
+
+        return jax.eval_shape(self.init_state, params)

@@ -1,0 +1,54 @@
+"""SGD with momentum (torch.optim.SGD semantics) for baseline parity tests."""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.optimizer import DSOptimizer
+
+
+class SGDState(NamedTuple):
+    step: jax.Array
+    momentum: Any
+
+
+class SGD(DSOptimizer):
+    def __init__(self, params=None, lr: float = 1e-2, momentum: float = 0.0, weight_decay: float = 0.0, nesterov: bool = False):  # noqa: ARG002
+        super().__init__(lr=lr, weight_decay=weight_decay, momentum=momentum)
+        self.nesterov = nesterov
+
+    def init_state(self, params: Any) -> SGDState:
+        return SGDState(
+            step=jnp.zeros((), jnp.int32),
+            momentum=jax.tree_util.tree_map(lambda p: jnp.zeros(jnp.shape(p), jnp.float32), params),
+        )
+
+    def state_specs(self, param_specs: Any) -> "SGDState":
+        from jax.sharding import PartitionSpec
+
+        return SGDState(step=PartitionSpec(), momentum=param_specs)
+
+    def apply(self, grads: Any, state: SGDState, params: Any, lr) -> Tuple[Any, SGDState]:
+        mom = self.defaults["momentum"]
+        wd = self.defaults["weight_decay"]
+
+        def leaf(p, g, b):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            if wd:
+                g = g + wd * p32
+            b = mom * b + g
+            d = g + mom * b if self.nesterov else b
+            return (p32 - lr * d).astype(p.dtype), b
+
+        flat_p, treedef = jax.tree_util.tree_flatten(params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_b = treedef.flatten_up_to(state.momentum)
+        out = [leaf(p, g, b) for p, g, b in zip(flat_p, flat_g, flat_b)]
+        return (
+            treedef.unflatten([o[0] for o in out]),
+            SGDState(state.step + 1, treedef.unflatten([o[1] for o in out])),
+        )

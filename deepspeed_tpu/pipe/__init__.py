@@ -1,0 +1,3 @@
+"""Pipeline-parallel user API (reference: ``deepspeed/pipe/__init__.py``)."""
+
+from deepspeed_tpu.runtime.pipe.module import LayerSpec, PipelineModule, TiedLayerSpec

@@ -1,0 +1,385 @@
+"""Main config: JSON/dict → ``DeepSpeedConfig``.
+
+Counterpart of the reference's ``deepspeed/runtime/config.py`` (batch-triad
+resolution, per-feature accessors) with the pydantic section models of
+``config_utils.py``. One TPU-native addition: a ``mesh`` section declaring the
+logical device-mesh axis sizes (data/model/sequence/expert/pipe); ``data`` is
+derived from the device count when left auto, matching the reference's
+"dp = world // (mp*pp)" derivation (``deepspeed/utils/groups.py``).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Union
+
+from pydantic import Field
+
+from deepspeed_tpu.runtime import constants as C
+from deepspeed_tpu.runtime.config_utils import (
+    DeepSpeedConfigModel,
+    ScientificNotationEncoder,
+    dict_raise_error_on_duplicate_keys,
+)
+from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig, ZeroStageEnum
+from deepspeed_tpu.utils.logging import logger
+
+
+class DeepSpeedConfigError(Exception):
+    pass
+
+
+class FP16Config(DeepSpeedConfigModel):
+    enabled: bool = False
+    auto_cast: bool = False
+    loss_scale: float = 0.0
+    initial_scale_power: int = 16
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    consecutive_hysteresis: bool = False
+    min_loss_scale: float = 1.0
+    fp16_master_weights_and_grads: bool = False
+
+
+class BF16Config(DeepSpeedConfigModel):
+    enabled: bool = False
+    # fp32 grad accumulation across micro-batches (reference bf16_optimizer)
+    immediate_grad_update: bool = False
+
+
+class OptimizerConfig(DeepSpeedConfigModel):
+    type: Optional[str] = None
+    params: Dict[str, Any] = Field(default_factory=dict)
+    legacy_fusion: bool = False
+
+
+class SchedulerConfig(DeepSpeedConfigModel):
+    type: Optional[str] = None
+    params: Dict[str, Any] = Field(default_factory=dict)
+
+
+class MeshConfig(DeepSpeedConfigModel):
+    """Logical device mesh axis sizes. 0/None = derive.
+
+    Axis names follow the scaling-book convention: data (DP/ZeRO), model (TP),
+    sequence (Ulysses SP), expert (MoE EP), pipe (PP).
+    """
+
+    data: int = 0
+    model: int = 1
+    sequence: int = 1
+    expert: int = 1
+    pipe: int = 1
+
+    def resolve(self, n_devices: int) -> "MeshConfig":
+        fixed = self.model * self.sequence * self.expert * self.pipe
+        if fixed <= 0 or n_devices % fixed != 0:
+            raise DeepSpeedConfigError(
+                f"mesh axes model×sequence×expert×pipe={fixed} do not divide device count {n_devices}"
+            )
+        data = self.data or n_devices // fixed
+        if data * fixed != n_devices:
+            raise DeepSpeedConfigError(
+                f"mesh {data}×{fixed} != device count {n_devices}"
+            )
+        return MeshConfig(data=data, model=self.model, sequence=self.sequence, expert=self.expert, pipe=self.pipe)
+
+
+class CommsLoggerConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    verbose: bool = False
+    prof_all: bool = True
+    debug: bool = False
+    prof_ops: List[str] = Field(default_factory=list)
+
+
+class CommsConfig(DeepSpeedConfigModel):
+    comms_logger: CommsLoggerConfig = Field(default_factory=CommsLoggerConfig)
+
+    @property
+    def comms_logger_enabled(self) -> bool:
+        return self.comms_logger.enabled
+
+
+class ActivationCheckpointingConfig(DeepSpeedConfigModel):
+    partition_activations: bool = False
+    cpu_checkpointing: bool = False
+    contiguous_memory_optimization: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+    # TPU-native: the jax.checkpoint policy name to apply to each block
+    policy: str = "nothing_saveable"
+
+
+class FlopsProfilerConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    recompute_fwd_factor: float = 0.0
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+
+class TensorBoardConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
+class WandbConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    group: Optional[str] = None
+    team: Optional[str] = None
+    project: str = "deepspeed"
+
+
+class CSVConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
+class MonitorConfig(DeepSpeedConfigModel):
+    tensorboard: TensorBoardConfig = Field(default_factory=TensorBoardConfig)
+    wandb: WandbConfig = Field(default_factory=WandbConfig)
+    csv_monitor: CSVConfig = Field(default_factory=CSVConfig)
+
+    @property
+    def enabled(self) -> bool:
+        return self.tensorboard.enabled or self.wandb.enabled or self.csv_monitor.enabled
+
+
+class CheckpointConfig(DeepSpeedConfigModel):
+    tag_validation: str = "Warn"
+    load_universal: bool = False
+    use_node_local_storage: bool = False
+    parallel_write: Dict[str, Any] = Field(default_factory=dict)
+
+
+class DataTypesConfig(DeepSpeedConfigModel):
+    grad_accum_dtype: Optional[str] = None
+
+
+class AMPConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    opt_level: str = "O1"
+
+
+class GradientCompressionConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+
+
+class HybridEngineConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    max_out_tokens: int = 512
+    inference_tp_size: int = 1
+    release_inference_cache: bool = False
+    pin_parameters: bool = True
+    tp_gather_partition_size: int = 8
+
+
+class EigenvalueConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    verbose: bool = False
+    max_iter: int = 100
+    tol: float = 1e-2
+    stability: float = 1e-6
+    gas_boundary_resolution: int = 1
+    layer_name: str = "bert.encoder.layer"
+    layer_num: int = 0
+
+
+class ElasticityConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    max_train_batch_size: int = 2000
+    micro_batch_sizes: List[int] = Field(default_factory=lambda: [2, 4, 6])
+    min_gpus: int = 1
+    max_gpus: int = 10000
+    min_time: int = 0
+    version: float = 0.1
+    ignore_non_elastic_batch_info: bool = False
+    prefer_larger_batch: bool = True
+
+
+class AutotuningConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    start_step: Optional[int] = None
+    end_step: Optional[int] = None
+    metric: str = "throughput"
+    metric_path: Optional[str] = None
+    arg_mappings: Optional[Dict[str, str]] = None
+    fast: bool = True
+    results_dir: str = "autotuning_results"
+    exps_dir: str = "autotuning_exps"
+    overwrite: bool = True
+    model_info: Optional[Dict[str, Any]] = None
+    model_info_path: Optional[str] = None
+    mp_size: int = 1
+    max_train_batch_size: Optional[int] = None
+    min_train_batch_size: int = 1
+    max_train_micro_batch_size_per_gpu: int = 1024
+    min_train_micro_batch_size_per_gpu: int = 1
+    num_tuning_micro_batch_sizes: int = 3
+    tuner_type: str = "gridsearch"
+    tuner_early_stopping: int = 5
+    tuner_num_trials: int = 50
+
+
+class DeepSpeedConfig:
+    """Parsed + validated config with reference-style attribute surface."""
+
+    def __init__(self, config: Union[str, Dict], mpu=None, mesh_device=None):
+        if isinstance(config, str):
+            with open(config) as f:
+                self._param_dict = json.load(f, object_pairs_hook=dict_raise_error_on_duplicate_keys)
+        elif isinstance(config, dict):
+            self._param_dict = dict(config)
+        else:
+            raise DeepSpeedConfigError(
+                f"Expected a string path or dict for the DeepSpeed config, got {type(config)}"
+            )
+        self.mpu = mpu
+        self.mesh_device = mesh_device
+        self._initialize_params(self._param_dict)
+        self._do_sanity_check()
+
+    def _initialize_params(self, pd: Dict) -> None:
+        get = pd.get
+        self.train_batch_size = _noauto(get(C.TRAIN_BATCH_SIZE))
+        self.train_micro_batch_size_per_gpu = _noauto(get(C.TRAIN_MICRO_BATCH_SIZE_PER_GPU))
+        self.gradient_accumulation_steps = _noauto(get(C.GRADIENT_ACCUMULATION_STEPS))
+        self.steps_per_print = get(C.STEPS_PER_PRINT, C.STEPS_PER_PRINT_DEFAULT)
+        self.dump_state = get(C.DUMP_STATE, C.DUMP_STATE_DEFAULT)
+        self.wall_clock_breakdown = get(C.WALL_CLOCK_BREAKDOWN, C.WALL_CLOCK_BREAKDOWN_DEFAULT)
+        self.memory_breakdown = get(C.MEMORY_BREAKDOWN, C.MEMORY_BREAKDOWN_DEFAULT)
+        self.gradient_clipping = get(C.GRADIENT_CLIPPING, C.GRADIENT_CLIPPING_DEFAULT)
+        self.prescale_gradients = get(C.PRESCALE_GRADIENTS, C.PRESCALE_GRADIENTS_DEFAULT)
+        self.gradient_predivide_factor = get(
+            C.GRADIENT_PREDIVIDE_FACTOR, C.GRADIENT_PREDIVIDE_FACTOR_DEFAULT
+        )
+        self.sparse_gradients_enabled = get(C.SPARSE_GRADIENTS, C.SPARSE_GRADIENTS_DEFAULT)
+        self.disable_allgather = get(C.DISABLE_ALLGATHER, C.DISABLE_ALLGATHER_DEFAULT)
+        self.seed = get(C.SEED, None)
+
+        self.fp16_config = FP16Config(**get(C.FP16, {}))
+        bf16_dict = get(C.BFLOAT16, get(C.BFLOAT16_OLD, {}))
+        self.bf16_config = BF16Config(**bf16_dict)
+        self.amp_config = AMPConfig(**get(C.AMP, {}))
+        self.zero_config = DeepSpeedZeroConfig(**get("zero_optimization", {}))
+        self.optimizer_config = OptimizerConfig(**get(C.OPTIMIZER, {})) if get(C.OPTIMIZER) else None
+        self.scheduler_config = SchedulerConfig(**get(C.SCHEDULER, {})) if get(C.SCHEDULER) else None
+        self.mesh_config = MeshConfig(**get(C.MESH, {}))
+        self.comms_config = CommsConfig(**{"comms_logger": get(C.COMMS_LOGGER, {})})
+        self.activation_checkpointing_config = ActivationCheckpointingConfig(
+            **get("activation_checkpointing", {})
+        )
+        self.flops_profiler_config = FlopsProfilerConfig(**get("flops_profiler", {}))
+        self.monitor_config = MonitorConfig(
+            tensorboard=get("tensorboard", {}),
+            wandb=get("wandb", {}),
+            csv_monitor=get("csv_monitor", {}),
+        )
+        self.checkpoint_config = CheckpointConfig(**get(C.CHECKPOINT, {}))
+        self.data_types_config = DataTypesConfig(**get(C.DATA_TYPES, {}))
+        self.hybrid_engine = HybridEngineConfig(**get("hybrid_engine", {}))
+        self.eigenvalue_config = EigenvalueConfig(**get(C.EIGENVALUE, {}))
+        self.elasticity_config = ElasticityConfig(**get("elasticity", {}))
+        self.autotuning_config = AutotuningConfig(**get("autotuning", {}))
+        self.compression_config = pd.get("compression_training", {})
+        self.data_efficiency_config = pd.get("data_efficiency", {})
+        self.curriculum_learning_config = pd.get("curriculum_learning", {})
+        self.nebula_config = pd.get("nebula", {})
+        self.aio_config = pd.get("aio", {})
+
+        self.zero_enabled = self.zero_config.stage > ZeroStageEnum.disabled
+        self.zero_optimization_stage = int(self.zero_config.stage)
+        self.fp16_enabled = self.fp16_config.enabled
+        self.bfloat16_enabled = self.bf16_config.enabled
+        self.amp_enabled = self.amp_config.enabled
+        self.loss_scale = self.fp16_config.loss_scale
+        self.initial_dynamic_scale = 2**self.fp16_config.initial_scale_power
+        self.dynamic_loss_scale_args = {
+            "init_scale": 2**self.fp16_config.initial_scale_power,
+            "scale_window": self.fp16_config.loss_scale_window,
+            "min_scale": self.fp16_config.min_loss_scale,
+            "delayed_shift": self.fp16_config.hysteresis,
+            "consecutive_hysteresis": self.fp16_config.consecutive_hysteresis,
+        }
+        self.checkpoint_tag_validation_enabled = (
+            self.checkpoint_config.tag_validation.lower() != "ignore"
+        )
+        self.checkpoint_tag_validation_fail = self.checkpoint_config.tag_validation.lower() == "fail"
+        self.load_universal_checkpoint = self.checkpoint_config.load_universal
+        self.elasticity_enabled = self.elasticity_config.enabled
+
+    def resolve_batch_triad(self, dp_world_size: int) -> None:
+        """Resolve train_batch = micro_batch × gas × dp (reference config.py).
+
+        Any one or two of the triad may be given; the rest are derived. All
+        three given → must multiply out exactly.
+        """
+        tb, mb, gas = (
+            self.train_batch_size,
+            self.train_micro_batch_size_per_gpu,
+            self.gradient_accumulation_steps,
+        )
+        if tb and mb and gas:
+            if tb != mb * gas * dp_world_size:
+                raise DeepSpeedConfigError(
+                    f"train_batch_size {tb} != micro_batch {mb} × gas {gas} × dp {dp_world_size}"
+                )
+        elif tb and mb:
+            gas, rem = divmod(tb, mb * dp_world_size)
+            if rem:
+                raise DeepSpeedConfigError(
+                    f"train_batch_size {tb} not divisible by micro_batch {mb} × dp {dp_world_size}"
+                )
+        elif tb and gas:
+            mb, rem = divmod(tb, gas * dp_world_size)
+            if rem:
+                raise DeepSpeedConfigError(
+                    f"train_batch_size {tb} not divisible by gas {gas} × dp {dp_world_size}"
+                )
+        elif mb and gas:
+            tb = mb * gas * dp_world_size
+        elif mb:
+            gas = 1
+            tb = mb * dp_world_size
+        elif tb:
+            mb, rem = divmod(tb, dp_world_size)
+            gas = 1
+            if rem:
+                raise DeepSpeedConfigError(
+                    f"train_batch_size {tb} not divisible by dp world size {dp_world_size}"
+                )
+        else:
+            raise DeepSpeedConfigError(
+                "At least one of train_batch_size / train_micro_batch_size_per_gpu / "
+                "gradient_accumulation_steps must be set"
+            )
+        self.train_batch_size = tb
+        self.train_micro_batch_size_per_gpu = mb
+        self.gradient_accumulation_steps = gas
+
+    def _do_sanity_check(self) -> None:
+        if self.fp16_enabled and self.bfloat16_enabled:
+            raise DeepSpeedConfigError("fp16 and bf16 cannot both be enabled")
+        if self.zero_enabled and self.zero_optimization_stage > int(ZeroStageEnum.max_stage):
+            raise DeepSpeedConfigError(
+                f"ZeRO stage {self.zero_optimization_stage} > max {int(ZeroStageEnum.max_stage)}"
+            )
+        if self.optimizer_config and self.optimizer_config.type:
+            from deepspeed_tpu.runtime.constants import DEEPSPEED_OPTIMIZERS
+
+            name = self.optimizer_config.type.lower()
+            if name not in DEEPSPEED_OPTIMIZERS:
+                logger.warning(f"optimizer {name!r} is not a DeepSpeed optimizer; treating as client-style")
+
+    def print_config(self, name: str = "DeepSpeedConfig") -> None:
+        logger.info(f"{name}:\n" + json.dumps(self._param_dict, indent=2, cls=ScientificNotationEncoder))
+
+
+def _noauto(v):
+    return None if v == "auto" else v

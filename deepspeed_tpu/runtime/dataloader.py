@@ -1,0 +1,110 @@
+"""Dataloader.
+
+Counterpart of ``deepspeed/runtime/dataloader.py`` (``DeepSpeedDataLoader``
+with ``DistributedSampler``). TPU-native behavior: batches are *global* —
+the engine shards the leading dim over the dense-DP mesh axes at
+``device_put`` time — so the sampler's job is only per-process slicing of the
+global batch when running multi-host (each host loads its addressable slice).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Iterable, Optional
+
+import numpy as np
+
+
+def _default_collate(items):
+    first = items[0]
+    if isinstance(first, dict):
+        return {k: _default_collate([it[k] for it in items]) for k in first}
+    if isinstance(first, (tuple, list)):
+        return type(first)(_default_collate([it[i] for it in items]) for i in range(len(first)))
+    return np.stack([np.asarray(it) for it in items])
+
+
+class RepeatingLoader:
+    """Wraps an iterator to restart on StopIteration (reference pipe utils)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)
+
+
+class DeepSpeedDataLoader:
+    def __init__(
+        self,
+        dataset,
+        batch_size: int,
+        collate_fn: Optional[Callable] = None,
+        shuffle: bool = False,
+        seed: int = 0,
+        drop_last: bool = True,
+        num_local_io_workers: Optional[int] = None,  # noqa: ARG002 - API parity
+        data_sampler=None,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn or _default_collate
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.data_sampler = data_sampler
+        self.epoch = 0
+        try:
+            self._len = len(dataset)
+        except TypeError:
+            self._len = None
+
+    def __len__(self) -> int:
+        if self._len is None:
+            raise TypeError("dataset has no length")
+        if self.drop_last:
+            return self._len // self.batch_size
+        return math.ceil(self._len / self.batch_size)
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def _indices(self):
+        n = self._len
+        order = np.arange(n)
+        if self.data_sampler is not None:
+            order = np.asarray(list(iter(self.data_sampler)))
+        elif self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            rng.shuffle(order)
+        return order
+
+    def __iter__(self):
+        if self._len is None:
+            # iterable dataset: batch on the fly
+            yield from self._iter_stream()
+            return
+        order = self._indices()
+        n_batches = len(self)
+        for b in range(n_batches):
+            idx = order[b * self.batch_size : (b + 1) * self.batch_size]
+            items = [self.dataset[int(i)] for i in idx]
+            yield self.collate_fn(items)
+
+    def _iter_stream(self):
+        buf = []
+        for item in self.dataset:
+            buf.append(item)
+            if len(buf) == self.batch_size:
+                yield self.collate_fn(buf)
+                buf = []
+        if buf and not self.drop_last:
+            yield self.collate_fn(buf)

@@ -1,0 +1,763 @@
+"""Training engine.
+
+TPU-native counterpart of the reference's ``DeepSpeedEngine``
+(``deepspeed/runtime/engine.py:174``). The public surface is preserved —
+``forward`` (engine.py:1740), ``backward`` (:1881), ``step`` (:2079),
+``save_checkpoint``/``load_checkpoint`` (:2961/:2638), gradient-accumulation
+boundary bookkeeping — but the internals are functional: all state lives in
+sharded jax.Arrays, and three jitted programs implement the hot loop:
+
+* ``_fwd_bwd``   — loss + grads + accumulate (forward & backward fused; the
+  reference's per-param grad hooks + bucketing, stage_1_and_2.py:858-1000,
+  become XLA-scheduled reduce-scatters emitted from grad out-shardings).
+* ``_step_fn``   — unscale, global-norm clip, overflow check, fused optimizer
+  update on the master shards, bf16 re-cast + all-gather (= stage step
+  :1705/stage3 :1880), loss-scale update — all inside one program, so an
+  overflow skip costs a ``where``, not a host sync.
+* ``_eval_fwd``  — forward only.
+
+ZeRO stages select the sharding trees (see ``runtime/zero/partition.py``);
+nothing else changes between stages — that is the point of doing ZeRO on the
+GSPMD partitioner instead of hooks.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from deepspeed_tpu import comm as dist
+from deepspeed_tpu.accelerator import get_accelerator
+from deepspeed_tpu.ops.adagrad.cpu_adagrad import DeepSpeedCPUAdagrad
+from deepspeed_tpu.ops.adam.fused_adam import Adam, AdamW, FusedAdam
+from deepspeed_tpu.ops.lamb.fused_lamb import FusedLamb
+from deepspeed_tpu.ops.optimizer import DSOptimizer
+from deepspeed_tpu.ops.sgd import SGD
+from deepspeed_tpu.parallel.mesh import Topology, get_topology, initialize_topology
+from deepspeed_tpu.runtime import constants as C
+from deepspeed_tpu.runtime.checkpoint_engine.orbax_checkpoint_engine import OrbaxCheckpointEngine
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.fp16.loss_scaler import (
+    CreateLossScaler,
+    LossScaleState,
+    has_inf_or_nan,
+)
+from deepspeed_tpu.runtime.lr_schedules import get_lr_scheduler
+from deepspeed_tpu.runtime.module import DSModule, wrap_module
+from deepspeed_tpu.runtime.zero.partition import ZeroPartitioner
+from deepspeed_tpu.utils.logging import log_dist, logger
+from deepspeed_tpu.utils.timer import (
+    BACKWARD_GLOBAL_TIMER,
+    FORWARD_GLOBAL_TIMER,
+    STEP_GLOBAL_TIMER,
+    NoopTimer,
+    SynchronizedWallClockTimer,
+    ThroughputTimer,
+)
+
+MEMORY_OPT_ALLREDUCE_SIZE = 500_000_000  # parity: engine.py:105
+
+_OPTIMIZER_REGISTRY = {
+    # reference parity: "adam" selects FusedAdam whose adam_w_mode defaults
+    # True (decoupled decay), engine.py:1233 + ops/adam/fused_adam.py
+    C.ADAM_OPTIMIZER: FusedAdam,
+    C.ADAMW_OPTIMIZER: AdamW,
+    C.FUSED_ADAM_OPTIMIZER: FusedAdam,
+    C.CPU_ADAM_OPTIMIZER: FusedAdam,  # host-offload variant selected via zero config
+    C.CPU_ADAGRAD_OPTIMIZER: DeepSpeedCPUAdagrad,
+    C.LAMB_OPTIMIZER: FusedLamb,
+    C.FUSED_LAMB_OPTIMIZER: FusedLamb,
+    C.SGD_OPTIMIZER: SGD,
+}
+
+
+class DeepSpeedEngine:
+    def __init__(
+        self,
+        args=None,
+        model=None,
+        optimizer: Optional[DSOptimizer] = None,
+        model_parameters: Any = None,
+        training_data=None,
+        lr_scheduler=None,
+        mpu=None,
+        dist_init_required: Optional[bool] = None,  # noqa: ARG002
+        collate_fn: Optional[Callable] = None,
+        config: Any = None,
+        config_class: Optional[DeepSpeedConfig] = None,
+        loss_fn: Optional[Callable] = None,
+        dont_change_device: bool = False,  # noqa: ARG002
+    ):
+        self.args = args
+        self.module: DSModule = wrap_module(model, loss_fn=loss_fn)
+        self.client_optimizer = optimizer
+        self.client_lr_scheduler = lr_scheduler
+        self.training_data = training_data
+        self.collate_fn = collate_fn
+        self.mpu = mpu
+
+        self._config = config_class or DeepSpeedConfig(config if config is not None else {}, mpu)
+        self.topology: Topology = get_topology() if _topology_matches(self._config) else initialize_topology(
+            self._config.mesh_config
+        )
+        self.mesh = self.topology.mesh
+        self._config.resolve_batch_triad(self.topology.get_data_parallel_world_size())
+
+        dist.configure(self._config)
+
+        # precision ------------------------------------------------------
+        if self._config.bfloat16_enabled:
+            self.compute_dtype = jnp.bfloat16
+        elif self._config.fp16_enabled:
+            self.compute_dtype = jnp.float16
+        else:
+            self.compute_dtype = jnp.float32
+        self.mixed_precision = self.compute_dtype != jnp.float32
+        self.dynamic_loss_scale = self._config.fp16_enabled and self._config.loss_scale == 0
+        self.loss_scaler = CreateLossScaler(
+            self.compute_dtype,
+            self._config.loss_scale,
+            self.dynamic_loss_scale,
+            self._config.dynamic_loss_scale_args,
+        )
+
+        # optimizer ------------------------------------------------------
+        self.optimizer = self._configure_optimizer()
+        self.lr_scheduler = self._configure_lr_scheduler()
+
+        # counters -------------------------------------------------------
+        self.micro_steps = 0
+        self.global_steps = 0
+        self.global_samples = 0
+        self.skipped_steps = 0
+        self._in_forward = False
+        self._training_mode = True
+
+        # timers ---------------------------------------------------------
+        self.wall_clock_breakdown = self._config.wall_clock_breakdown
+        self.timers = SynchronizedWallClockTimer() if self.wall_clock_breakdown else NoopTimer()
+        self.tput_timer = ThroughputTimer(
+            batch_size=self.train_batch_size(),
+            steps_per_output=self._config.steps_per_print,
+            logging_fn=lambda msg: log_dist(msg, ranks=[0]),
+        )
+
+        # monitor --------------------------------------------------------
+        self.monitor = None
+        if self._config.monitor_config.enabled:
+            from deepspeed_tpu.monitor.monitor import MonitorMaster
+
+            self.monitor = MonitorMaster(self._config.monitor_config)
+
+        # checkpoint engine ----------------------------------------------
+        self.checkpoint_engine = OrbaxCheckpointEngine(self._config)
+
+        # state (lazily initialized on first batch or from model_parameters)
+        self._initialized = False
+        self._params = None  # compute-dtype tree
+        self._master = None  # fp32 master tree (is _params when not mixed / stage0 fp32)
+        self._opt_state = None
+        self._grad_acc = None
+        self._scale_state: Optional[LossScaleState] = None
+        self._rng = jax.random.PRNGKey(self._config.seed if self._config.seed is not None else 42)
+        self._last_loss = None
+        self._last_grad_norm = None
+        self._overflow = False
+        self._pending_model_parameters = model_parameters
+
+        self.partitioner: Optional[ZeroPartitioner] = None
+        self._jit_fwd_bwd = None
+        self._jit_eval = None
+        self._jit_step = None
+        self._batch_spec_fn = None
+
+        self.training_dataloader = self.deepspeed_io(training_data) if training_data is not None else None
+
+        log_dist(
+            f"DeepSpeedEngine configured: zero_stage={self.zero_optimization_stage()} "
+            f"dtype={self.compute_dtype.__name__ if hasattr(self.compute_dtype, '__name__') else self.compute_dtype} "
+            f"mesh={dict(zip(self.mesh.axis_names, self.mesh.devices.shape))} "
+            f"batch triad=({self.train_batch_size()},{self.train_micro_batch_size_per_gpu()},{self.gradient_accumulation_steps()})",
+            ranks=[0],
+        )
+
+    # ------------------------------------------------------------------
+    # configuration accessors (reference API parity)
+    # ------------------------------------------------------------------
+    def train_batch_size(self) -> int:
+        return self._config.train_batch_size
+
+    def train_micro_batch_size_per_gpu(self) -> int:
+        return self._config.train_micro_batch_size_per_gpu
+
+    def gradient_accumulation_steps(self) -> int:
+        return self._config.gradient_accumulation_steps
+
+    def zero_optimization_stage(self) -> int:
+        return self._config.zero_optimization_stage
+
+    def zero_optimization(self) -> bool:
+        return self._config.zero_enabled
+
+    def fp16_enabled(self) -> bool:
+        return self._config.fp16_enabled
+
+    def bfloat16_enabled(self) -> bool:
+        return self._config.bfloat16_enabled
+
+    def gradient_clipping(self) -> float:
+        return self._config.gradient_clipping
+
+    def data_parallel_world_size(self) -> int:
+        return self.topology.get_data_parallel_world_size()
+
+    @property
+    def loss_scale(self) -> float:
+        if self._scale_state is None:
+            return self.loss_scaler.init_scale
+        return float(jax.device_get(self._scale_state.scale))
+
+    def get_lr(self):
+        return self.optimizer.get_lr()
+
+    def get_global_grad_norm(self) -> Optional[float]:
+        if self._last_grad_norm is None:
+            return None
+        return float(jax.device_get(self._last_grad_norm))
+
+    def is_gradient_accumulation_boundary(self) -> bool:
+        return (self.micro_steps + 1) % self.gradient_accumulation_steps() == 0
+
+    def train(self, mode: bool = True):
+        self._training_mode = mode
+        return self
+
+    def eval(self):
+        return self.train(False)
+
+    # ------------------------------------------------------------------
+    # optimizer / scheduler wiring
+    # ------------------------------------------------------------------
+    def _configure_optimizer(self) -> DSOptimizer:
+        if self.client_optimizer is not None:
+            if not isinstance(self.client_optimizer, DSOptimizer):
+                raise TypeError(
+                    "client optimizer must be a deepspeed_tpu DSOptimizer (functional update rule)"
+                )
+            log_dist("Using client optimizer", ranks=[0])
+            return self.client_optimizer
+        opt_cfg = self._config.optimizer_config
+        if opt_cfg is None or not opt_cfg.type:
+            log_dist("No optimizer configured; defaulting to FusedAdam(lr=1e-3)", ranks=[0])
+            return FusedAdam(lr=1e-3)
+        name = opt_cfg.type.lower()
+        cls = _OPTIMIZER_REGISTRY.get(name)
+        if cls is None:
+            raise ValueError(f"Unknown optimizer {opt_cfg.type!r}")
+        params = dict(opt_cfg.params)
+        params.pop("torch_adam", None)
+        if "betas" in params:
+            params["betas"] = tuple(params["betas"])
+        return cls(**params)
+
+    def _configure_lr_scheduler(self):
+        if self.client_lr_scheduler is not None:
+            if callable(self.client_lr_scheduler):
+                return self.client_lr_scheduler(self.optimizer)
+            return self.client_lr_scheduler
+        sched_cfg = self._config.scheduler_config
+        if sched_cfg is None or not sched_cfg.type:
+            return None
+        return get_lr_scheduler(sched_cfg.type, self.optimizer, **sched_cfg.params)
+
+    # ------------------------------------------------------------------
+    # dataloader
+    # ------------------------------------------------------------------
+    def deepspeed_io(self, dataset, batch_size=None, route=None, pin_memory=True, data_sampler=None, collate_fn=None, num_local_io_workers=None):  # noqa: ARG002
+        from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+
+        return DeepSpeedDataLoader(
+            dataset,
+            batch_size=batch_size or self.train_micro_batch_size_per_gpu() * self.data_parallel_world_size(),
+            collate_fn=collate_fn or self.collate_fn,
+        )
+
+    # ------------------------------------------------------------------
+    # state initialization
+    # ------------------------------------------------------------------
+    def init_params(self, batch: Any, rng: Optional[jax.Array] = None) -> None:
+        """Materialize sharded params/master/opt-state from a sample batch."""
+        if self._initialized:
+            return
+        if rng is not None:
+            self._rng = rng
+        placed = self._place_batch(batch)
+        param_shapes = jax.eval_shape(lambda r, b: self.module.init(r, b), self._rng, placed)
+        tp_rules = self.module.tp_partition_rules(param_shapes)
+        self.partitioner = ZeroPartitioner(self._config.zero_config, self.topology, tp_rules)
+
+        self._param_specs = self.partitioner.param_specs(param_shapes)
+        self._master_specs = self.partitioner.master_specs(param_shapes)
+        self._grad_specs = self.partitioner.grad_accum_specs(param_shapes)
+        param_shardings = self.partitioner.shardings(self._param_specs)
+        master_shardings = self.partitioner.shardings(self._master_specs)
+        grad_shardings = self.partitioner.shardings(self._grad_specs)
+        self._param_shardings = param_shardings
+        self._master_shardings = master_shardings
+        self._grad_shardings = grad_shardings
+
+        if self._pending_model_parameters is not None:
+            src = self._pending_model_parameters
+            master = jax.tree_util.tree_map(lambda p: jnp.asarray(p, dtype=jnp.float32), src)
+            master = jax.jit(lambda t: t, out_shardings=master_shardings)(master)
+        else:
+            def _sharded_init(r, b):
+                p = self.module.init(r, b)
+                return jax.tree_util.tree_map(lambda x: x.astype(jnp.float32), p)
+
+            master = jax.jit(_sharded_init, out_shardings=master_shardings)(self._rng, placed)
+
+        if self.mixed_precision:
+            cast = jax.jit(
+                lambda t: jax.tree_util.tree_map(lambda x: x.astype(self.compute_dtype), t),
+                out_shardings=param_shardings,
+            )
+            self._params = cast(master)
+            self._master = master
+        else:
+            # fp32 training: one copy, stored with the (possibly ZeRO-3) param
+            # sharding; the optimizer updates it directly.
+            self._params = jax.jit(lambda t: t, out_shardings=param_shardings)(master)
+            self._master = self._params
+
+        opt_specs = self.optimizer.state_specs(self._master_specs)
+        opt_shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(self.mesh, s),
+            opt_specs,
+            is_leaf=lambda x: isinstance(x, PartitionSpec),
+        )
+        self._opt_state = jax.jit(self.optimizer.init_state, out_shardings=opt_shardings)(self._master)
+        self._opt_shardings = opt_shardings
+
+        zeros32 = jax.jit(
+            lambda t: jax.tree_util.tree_map(lambda x: jnp.zeros(x.shape, jnp.float32), t),
+            out_shardings=grad_shardings,
+        )
+        self._grad_acc = zeros32(self._master)
+        self._scale_state = jax.device_put(self.loss_scaler.init_state())
+        self._build_jitted_fns()
+        self._initialized = True
+        n_params = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(self._master))
+        log_dist(f"Initialized model state: {n_params:,} parameters", ranks=[0])
+
+    def _batch_pspec(self, batch) -> Any:
+        """Batch sharding: leading dim over the dense-DP axes, dim 1 (sequence)
+        over the sequence axis when SP is on."""
+        dp_axes = tuple(a for a in ("data", "expert") if self.topology.axis_size(a) > 1) or None
+        seq = self.topology.config.sequence > 1
+
+        def leaf_spec(x):
+            nd = np.ndim(x)
+            if nd == 0:
+                return PartitionSpec()
+            entries = [dp_axes if isinstance(dp_axes, tuple) and len(dp_axes) > 1 else (dp_axes[0] if dp_axes else None)]
+            if nd >= 2 and seq:
+                entries.append("sequence")
+            entries += [None] * (nd - len(entries))
+            return PartitionSpec(*entries)
+
+        return jax.tree_util.tree_map(leaf_spec, batch)
+
+    def _place_batch(self, batch):
+        """Device-put a host batch as a global sharded array."""
+        if all(isinstance(x, jax.Array) for x in jax.tree_util.tree_leaves(batch)):
+            return batch
+        specs = self._batch_pspec(batch)
+        shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(self.mesh, s), specs, is_leaf=lambda x: isinstance(x, PartitionSpec)
+        )
+        if jax.process_count() == 1:
+            return jax.device_put(batch, shardings)
+
+        # Multi-host: every host holds the same GLOBAL batch (the dataloader
+        # is deterministic across hosts); each device picks its slice, so no
+        # sample is duplicated and the global shape equals the batch shape.
+        def place(leaf, sharding):
+            arr = np.asarray(leaf)
+            return jax.make_array_from_callback(arr.shape, sharding, lambda idx: arr[idx])
+
+        return jax.tree_util.tree_map(
+            place, batch, shardings, is_leaf=lambda x: isinstance(x, np.ndarray)
+        )
+
+    # ------------------------------------------------------------------
+    # jitted programs
+    # ------------------------------------------------------------------
+    def _build_jitted_fns(self) -> None:
+        module = self.module
+        grad_specs = self._grad_specs
+        mesh = self.mesh
+        gas = self.gradient_accumulation_steps()
+        clip = self._config.gradient_clipping
+        fp16 = self._config.fp16_enabled
+        scaler = self.loss_scaler
+        optimizer = self.optimizer
+        compute_dtype = self.compute_dtype
+        mixed = self.mixed_precision
+
+        def loss_of(params, batch, rng):
+            out = module.apply(params, batch, rngs={"dropout": rng}, train=True)
+            if isinstance(out, tuple):
+                return out[0]
+            return out
+
+        def fwd_bwd(params, grad_acc, scale, rng, batch):
+            def scaled_loss(p):
+                return loss_of(p, batch, rng) * scale.astype(jnp.float32)
+
+            loss_scaled, grads = jax.value_and_grad(scaled_loss)(params)
+            new_acc = jax.tree_util.tree_map(
+                lambda a, g, s: jax.lax.with_sharding_constraint(a + g.astype(jnp.float32), NamedSharding(mesh, s)),
+                grad_acc,
+                grads,
+                grad_specs,
+                is_leaf=lambda x: isinstance(x, PartitionSpec),
+            )
+            return loss_scaled / scale.astype(jnp.float32), new_acc
+
+        self._jit_fwd_bwd = jax.jit(fwd_bwd, donate_argnums=(1,))
+
+        def eval_fwd(params, rng, batch):
+            out = module.apply(params, batch, rngs={"dropout": rng}, train=False)
+            return out
+
+        self._jit_eval = jax.jit(eval_fwd)
+
+        def step_fn(params_or_none, master, opt_state, grad_acc, scale_state, lr):
+            params = master if params_or_none is None else params_or_none
+            inv = 1.0 / (scale_state.scale * gas)
+            grads = jax.tree_util.tree_map(lambda g: g * inv, grad_acc)
+            overflow = has_inf_or_nan(grads) if fp16 else jnp.zeros((), jnp.bool_)
+            # global grad norm: full reductions over sharded leaves are global
+            sq = sum(jnp.sum(jnp.square(g)) for g in jax.tree_util.tree_leaves(grads))
+            grad_norm = jnp.sqrt(sq)
+            if clip > 0:
+                coef = jnp.minimum(1.0, clip / (grad_norm + 1e-6))
+                grads = jax.tree_util.tree_map(lambda g: g * coef, grads)
+            new_master, new_opt = optimizer.apply(grads, opt_state, master, jnp.float32(lr))
+            keep = lambda new, old: jax.tree_util.tree_map(
+                lambda n, o: jnp.where(overflow, o, n), new, old
+            )
+            new_master = keep(new_master, master)
+            new_opt = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(overflow, o, n), new_opt, opt_state
+            )
+            if mixed:
+                new_params = jax.tree_util.tree_map(
+                    lambda m, p: jnp.where(overflow, p, m.astype(compute_dtype)), new_master, params
+                )
+            else:
+                new_params = new_master
+            zeroed = jax.tree_util.tree_map(jnp.zeros_like, grad_acc)
+            new_scale_state = scaler.update(scale_state, overflow)
+            return new_params, new_master, new_opt, zeroed, new_scale_state, grad_norm, overflow
+
+        if mixed:
+            self._jit_step = jax.jit(
+                step_fn,
+                donate_argnums=(0, 1, 2, 3),
+                out_shardings=(
+                    self._param_shardings,
+                    self._master_shardings,
+                    self._opt_shardings,
+                    self._grad_shardings,
+                    None,
+                    None,
+                    None,
+                ),
+            )
+        else:
+            # fp32: params IS master — a single buffer; pass and return it once
+            # to avoid donating the same buffer under two arguments.
+            def fp32_step(master, opt_state, grad_acc, scale_state, lr):
+                out = step_fn(None, master, opt_state, grad_acc, scale_state, lr)
+                return out[1], out[2], out[3], out[4], out[5], out[6]
+
+            self._jit_step = jax.jit(
+                fp32_step,
+                donate_argnums=(0, 1, 2),
+                out_shardings=(
+                    self._master_shardings,
+                    self._opt_shardings,
+                    self._grad_shardings,
+                    None,
+                    None,
+                    None,
+                ),
+            )
+
+    # ------------------------------------------------------------------
+    # train loop API (reference parity)
+    # ------------------------------------------------------------------
+    def __call__(self, batch):
+        return self.forward(batch)
+
+    def forward(self, batch):
+        if not self._initialized:
+            self.init_params(batch)
+        self.timers(FORWARD_GLOBAL_TIMER).start()
+        self.tput_timer.start()
+        placed = self._place_batch(batch)
+        self._rng, step_rng = jax.random.split(self._rng)
+        if self._training_mode:
+            loss, self._grad_acc = self._jit_fwd_bwd(
+                self._params, self._grad_acc, self._scale_state.scale, step_rng, placed
+            )
+            self._last_loss = loss
+            self._in_forward = True
+        else:
+            loss = self._jit_eval(self._params, step_rng, placed)
+            self._last_loss = loss
+        self.timers(FORWARD_GLOBAL_TIMER).stop(sync=False)
+        return loss
+
+    def backward(self, loss, retain_graph: bool = False, scale_wrt_gas: bool = True):  # noqa: ARG002
+        """Gradients were produced (fused) in ``forward``; this validates the
+        call protocol and is where the reference reduces at GAS boundaries —
+        here the reduction is part of the jitted step's grad shardings."""
+        if not self._training_mode:
+            raise RuntimeError("backward() called in eval mode")
+        if not self._in_forward:
+            raise RuntimeError("backward() called before forward()")
+        self.timers(BACKWARD_GLOBAL_TIMER).start()
+        self._in_forward = False
+        self.timers(BACKWARD_GLOBAL_TIMER).stop(sync=False)
+        return loss
+
+    def step(self, lr_kwargs=None):  # noqa: ARG002
+        self.timers(STEP_GLOBAL_TIMER).start()
+        boundary = self.is_gradient_accumulation_boundary()
+        if boundary:
+            self._take_model_step()
+        self.micro_steps += 1
+        self.global_samples += self.train_micro_batch_size_per_gpu() * self.data_parallel_world_size()
+        self.timers(STEP_GLOBAL_TIMER).stop(sync=False)
+        self.tput_timer.stop(global_step=boundary)
+
+    def _take_model_step(self) -> None:
+        lr = self.optimizer.param_groups[0]["lr"]
+        if self.mixed_precision:
+            (
+                self._params,
+                self._master,
+                self._opt_state,
+                self._grad_acc,
+                self._scale_state,
+                self._last_grad_norm,
+                overflow_flag,
+            ) = self._jit_step(
+                self._params, self._master, self._opt_state, self._grad_acc, self._scale_state, lr
+            )
+        else:
+            (
+                self._master,
+                self._opt_state,
+                self._grad_acc,
+                self._scale_state,
+                self._last_grad_norm,
+                overflow_flag,
+            ) = self._jit_step(self._master, self._opt_state, self._grad_acc, self._scale_state, lr)
+            self._params = self._master
+        self.global_steps += 1
+        if self._config.fp16_enabled:
+            # only fp16 needs the host-visible flag (scheduler skip + counters)
+            self._overflow = bool(jax.device_get(overflow_flag))
+            if self._overflow:
+                self.skipped_steps += 1
+                log_dist(
+                    f"[deepspeed_tpu] OVERFLOW! skipping step, new loss scale: {self.loss_scale}",
+                    ranks=[0],
+                )
+        if self.lr_scheduler is not None and not self._overflow:
+            self.lr_scheduler.step()
+        self._overflow = False
+        if self.monitor is not None and self.global_steps % self._config.steps_per_print == 0:
+            self._write_monitor()
+
+    def _write_monitor(self) -> None:
+        events = [
+            ("Train/Samples/lr", self.optimizer.param_groups[0]["lr"], self.global_samples),
+        ]
+        if self._last_loss is not None:
+            events.append(("Train/Samples/train_loss", float(jax.device_get(self._last_loss)), self.global_samples))
+        self.monitor.write_events(events)
+
+    def train_batch(self, data_iter=None, batch=None):
+        """Convenience: run a full GAS cycle (gas × fwd/bwd + step)."""
+        losses = []
+        for _ in range(self.gradient_accumulation_steps()):
+            if batch is None:
+                b = next(data_iter)
+            else:
+                b = batch
+            loss = self.forward(b)
+            self.backward(loss)
+            self.step()
+            losses.append(loss)
+        total = sum(jax.device_get(l) for l in losses) / len(losses)
+        return total
+
+    # ------------------------------------------------------------------
+    # checkpointing (reference: engine.py:2961 save / :2638 load)
+    # ------------------------------------------------------------------
+    def _ckpt_dir(self, save_dir: str, tag: str) -> str:
+        return os.path.join(save_dir, str(tag))
+
+    def save_checkpoint(self, save_dir: str, tag: Optional[str] = None, client_state: Optional[Dict] = None, save_latest: bool = True, exclude_frozen_parameters: bool = False):  # noqa: ARG002
+        if not self._initialized:
+            raise RuntimeError("cannot save before the engine state is initialized")
+        if tag is None:
+            tag = f"global_step{self.global_steps}"
+        self._validate_checkpoint_tag(tag)
+        path = self._ckpt_dir(save_dir, tag)
+        self.checkpoint_engine.create(tag)
+        state = {
+            "module": self._params,
+            "master": self._master if self.mixed_precision else None,
+            "optimizer": _namedtuple_to_dict(self._opt_state),
+            "loss_scaler": _namedtuple_to_dict(self._scale_state),
+            "lr_scheduler": self.lr_scheduler.state_dict() if self.lr_scheduler is not None else None,
+            "global_steps": self.global_steps,
+            "global_samples": self.global_samples,
+            "micro_steps": self.micro_steps,
+            "skipped_steps": self.skipped_steps,
+            "ds_config": self._config._param_dict,
+            "ds_version": _version(),
+            "client_state": client_state or {},
+        }
+        self.checkpoint_engine.save(state, path)
+        if save_latest and dist.get_rank() == 0:
+            os.makedirs(save_dir, exist_ok=True)
+            with open(os.path.join(save_dir, "latest"), "w") as f:
+                f.write(tag)
+        self.checkpoint_engine.commit(tag)
+        dist.barrier(name="save_checkpoint")
+        return True
+
+    def _validate_checkpoint_tag(self, tag: str) -> None:
+        """Cross-rank tag equality check (reference engine.py:2944)."""
+        if not self._config.checkpoint_tag_validation_enabled or dist.get_world_size() == 1:
+            return
+        tags = dist.all_gather_object(tag)
+        if any(t != tag for t in tags):
+            msg = f"checkpoint tag mismatch across ranks: {tags}"
+            if self._config.checkpoint_tag_validation_fail:
+                raise RuntimeError(msg)
+            logger.warning(msg)
+
+    def load_checkpoint(
+        self,
+        load_dir: str,
+        tag: Optional[str] = None,
+        load_module_strict: bool = True,  # noqa: ARG002
+        load_optimizer_states: bool = True,
+        load_lr_scheduler_states: bool = True,
+        load_module_only: bool = False,
+        custom_load_fn: Optional[Callable] = None,  # noqa: ARG002
+    ):
+        if tag is None:
+            latest = os.path.join(load_dir, "latest")
+            if not os.path.isfile(latest):
+                logger.warning(f"no 'latest' file at {latest}; nothing loaded")
+                return None, {}
+            with open(latest) as f:
+                tag = f.read().strip()
+        path = self._ckpt_dir(load_dir, tag)
+        state = self.checkpoint_engine.load(path)
+        if not self._initialized:
+            raise RuntimeError(
+                "engine state must be initialized before load_checkpoint (call init_params "
+                "with a sample batch, or run one forward)"
+            )
+        put_p = jax.jit(lambda t: t, out_shardings=self._param_shardings)
+        self._params = put_p(jax.tree_util.tree_map(jnp.asarray, state["module"]))
+        if self.mixed_precision and state.get("master") is not None:
+            put_m = jax.jit(lambda t: t, out_shardings=self._master_shardings)
+            self._master = put_m(jax.tree_util.tree_map(jnp.asarray, state["master"]))
+        elif not self.mixed_precision:
+            self._master = self._params
+        if load_optimizer_states and not load_module_only and state.get("optimizer") is not None:
+            opt = _dict_to_namedtuple(state["optimizer"], type(self._opt_state))
+            put_o = jax.jit(lambda t: t, out_shardings=self._opt_shardings)
+            self._opt_state = put_o(jax.tree_util.tree_map(jnp.asarray, opt))
+        if state.get("loss_scaler") is not None:
+            self._scale_state = jax.device_put(
+                _dict_to_namedtuple(state["loss_scaler"], LossScaleState)
+            )
+        if load_lr_scheduler_states and self.lr_scheduler is not None and state.get("lr_scheduler"):
+            self.lr_scheduler.load_state_dict(state["lr_scheduler"])
+        if not load_module_only:
+            self.global_steps = state.get("global_steps", 0)
+            self.global_samples = state.get("global_samples", 0)
+            self.micro_steps = state.get("micro_steps", 0)
+            self.skipped_steps = state.get("skipped_steps", 0)
+        client_state = state.get("client_state", {})
+        return path, client_state
+
+    # ------------------------------------------------------------------
+    # introspection / utils
+    # ------------------------------------------------------------------
+    def get_params(self):
+        return self._params
+
+    def get_master_params(self):
+        return self._master
+
+    def num_parameters(self) -> int:
+        if not self._initialized:
+            return 0
+        return sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(self._master))
+
+
+def _namedtuple_to_dict(nt):
+    if nt is None:
+        return None
+    if hasattr(nt, "_asdict"):
+        return {k: _namedtuple_to_dict(v) for k, v in nt._asdict().items()}
+    return nt
+
+
+def _dict_to_namedtuple(d, cls):
+    if d is None:
+        return None
+    fields = cls._fields
+    vals = []
+    for f in fields:
+        v = d[f]
+        vals.append(v)
+    return cls(*vals)
+
+
+def _topology_matches(config: DeepSpeedConfig) -> bool:
+    from deepspeed_tpu.parallel import mesh as mesh_mod
+
+    topo = mesh_mod._TOPOLOGY
+    if topo is None:
+        return False
+    try:
+        resolved = config.mesh_config.resolve(topo.world_size)
+    except Exception:
+        return False
+    return resolved.model_dump() == topo.config.model_dump()
+
+
+def _version() -> str:
+    from deepspeed_tpu import __version__
+
+    return __version__

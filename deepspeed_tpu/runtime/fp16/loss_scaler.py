@@ -1,0 +1,123 @@
+"""Loss scaling.
+
+Counterpart of ``deepspeed/runtime/fp16/loss_scaler.py`` (``LossScaler``,
+``DynamicLossScaler``). The scale lives as a traced fp32 scalar inside the
+train-step state so scale updates and overflow-skip happen *inside* jit with
+``jnp.where`` — no host round-trip in the hot loop (the reference synchronizes
+on the overflow flag every step; we read it back only for logging).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+INITIAL_LOSS_SCALE = "init_scale"
+SCALE_WINDOW = "scale_window"
+DELAYED_SHIFT = "delayed_shift"
+MIN_LOSS_SCALE = "min_scale"
+
+
+class LossScaleState(NamedTuple):
+    scale: jax.Array  # fp32 scalar
+    good_steps: jax.Array  # int32 scalar
+    hysteresis: jax.Array  # int32 scalar
+
+
+class LossScalerBase:
+    """Static (or no-op) scaling."""
+
+    dynamic = False
+
+    def __init__(self, scale: float = 1.0):
+        self.init_scale = float(scale)
+
+    def init_state(self) -> LossScaleState:
+        return LossScaleState(
+            scale=jnp.float32(self.init_scale),
+            good_steps=jnp.zeros((), jnp.int32),
+            hysteresis=jnp.zeros((), jnp.int32),
+        )
+
+    def update(self, state: LossScaleState, overflow) -> LossScaleState:  # noqa: ARG002
+        return state
+
+
+class LossScaler(LossScalerBase):
+    pass
+
+
+class DynamicLossScaler(LossScalerBase):
+    dynamic = True
+
+    def __init__(
+        self,
+        init_scale: float = 2**32,
+        scale_factor: float = 2.0,
+        scale_window: int = 1000,
+        min_scale: float = 1.0,
+        delayed_shift: int = 1,
+        consecutive_hysteresis: bool = False,
+    ):
+        super().__init__(init_scale)
+        self.scale_factor = float(scale_factor)
+        self.scale_window = int(scale_window)
+        self.min_scale = float(min_scale)
+        self.delayed_shift = int(delayed_shift)
+        self.consecutive_hysteresis = consecutive_hysteresis
+
+    def init_state(self) -> LossScaleState:
+        return LossScaleState(
+            scale=jnp.float32(self.init_scale),
+            good_steps=jnp.zeros((), jnp.int32),
+            hysteresis=jnp.full((), self.delayed_shift, jnp.int32),
+        )
+
+    def update(self, state: LossScaleState, overflow) -> LossScaleState:
+        """Pure (jit-traceable) scale update given a bool overflow scalar."""
+        hysteresis = jnp.where(overflow, jnp.maximum(state.hysteresis - 1, 0), state.hysteresis)
+        must_shrink = overflow & (hysteresis <= 0)
+        shrink_scale = jnp.maximum(state.scale / self.scale_factor, self.min_scale)
+        window_full = (state.good_steps + 1) >= self.scale_window
+        grow_scale = jnp.where(window_full, state.scale * self.scale_factor, state.scale)
+        new_scale = jnp.where(must_shrink, shrink_scale, jnp.where(overflow, state.scale, grow_scale))
+        new_good = jnp.where(overflow, 0, jnp.where(window_full, 0, state.good_steps + 1))
+        new_hyst = jnp.where(
+            must_shrink,
+            self.delayed_shift,
+            jnp.where(
+                (~overflow) & (not self.consecutive_hysteresis),
+                self.delayed_shift,
+                hysteresis,
+            ),
+        )
+        return LossScaleState(scale=new_scale, good_steps=new_good, hysteresis=new_hyst.astype(jnp.int32))
+
+
+def CreateLossScaler(dtype, static_loss_scale, dynamic_scaling, dynamic_loss_args):
+    """Factory mirroring the reference's selection logic (loss_scaler.py)."""
+    import jax.numpy as jnp  # noqa: F811
+
+    if dtype == jnp.float16 and dynamic_scaling:
+        kwargs = dynamic_loss_args or {}
+        return DynamicLossScaler(
+            init_scale=kwargs.get(INITIAL_LOSS_SCALE, 2**16),
+            scale_window=kwargs.get(SCALE_WINDOW, 1000),
+            min_scale=kwargs.get(MIN_LOSS_SCALE, 1.0),
+            delayed_shift=kwargs.get(DELAYED_SHIFT, 1),
+            consecutive_hysteresis=kwargs.get("consecutive_hysteresis", False),
+        )
+    scale = static_loss_scale if (dtype == jnp.float16 and static_loss_scale) else 1.0
+    return LossScaler(scale=scale)
+
+
+def has_inf_or_nan(tree) -> jax.Array:
+    """Global overflow check (reference ``_has_inf_or_nan`` stage_1_and_2.py:1909)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    flags = [~jnp.isfinite(l.astype(jnp.float32)).all() for l in leaves]
+    out = jnp.zeros((), jnp.bool_)
+    for f in flags:
+        out = out | f
+    return out

@@ -1,0 +1,66 @@
+"""Hybrid engine (RLHF / DS-Chat).
+
+Counterpart of the reference's ``DeepSpeedHybridEngine``
+(``deepspeed/runtime/hybrid_engine.py:32``): one engine that flips between
+ZeRO training mode and inference mode over the *same* weights for
+generate-then-train loops. On TPU the flip is free — the live (sharded) bf16
+param tree is passed to a jitted eval/generate program; no gather/re-partition
+dance is needed because both programs read the same sharded buffers.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class DeepSpeedHybridEngine(DeepSpeedEngine):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._in_inference_mode = False
+        self._generate_jit = None
+        cfg = self._config.hybrid_engine
+        self.max_out_tokens = cfg.max_out_tokens
+        log_dist(f"HybridEngine: max_out_tokens={self.max_out_tokens}", ranks=[0])
+
+    def eval(self):
+        self._in_inference_mode = True
+        return super().eval()
+
+    def train(self, mode: bool = True):
+        self._in_inference_mode = not mode
+        return super().train(mode)
+
+    def generate(self, input_ids, max_new_tokens: Optional[int] = None, eos_token_id: Optional[int] = None, pad_token_id: int = 0):
+        """Greedy decode with the CURRENT training weights (the RLHF actor
+        rollout step); one compiled program per (batch, max_len) bucket. The
+        module's apply must return logits for a token-id batch."""
+        from deepspeed_tpu.inference.generation import greedy_generate
+
+        if not self._initialized:
+            self.init_params(jnp.asarray(input_ids))
+        max_new = max_new_tokens or self.max_out_tokens
+        module = self.module
+
+        def apply_fn(params, tokens, rng):
+            return module.apply(params, tokens, rngs={"dropout": rng}, train=False)
+
+        if self._generate_jit is None:
+            self._generate_jit = {}
+        self._rng, sub = jax.random.split(self._rng)
+        return greedy_generate(
+            apply_fn,
+            self._params,
+            input_ids,
+            max_new,
+            sub,
+            eos_token_id=eos_token_id,
+            pad_token_id=pad_token_id,
+            jit_cache=self._generate_jit,
+        )

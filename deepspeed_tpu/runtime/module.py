@@ -1,0 +1,126 @@
+"""Model protocol the engine trains.
+
+The reference wraps an eagerly-built ``torch.nn.Module``; a TPU-native engine
+trains a *functional* model: ``init`` builds a param pytree, ``apply`` maps
+(params, batch) → loss. ``DSModule`` is the protocol; ``wrap_module`` adapts
+the things users actually hand to ``deepspeed.initialize`` — a Flax linen
+module (+ optional ``loss_fn``), an ``(init_fn, apply_fn)`` pair, or a
+``DSModule``.
+
+A key semantic difference, forced by functional autodiff: the loss must be
+computed inside the engine's traced step, so the module's ``apply`` (or the
+provided ``loss_fn``) returns the scalar loss — the same contract the
+reference's ``PipelineModule(loss_fn=...)`` already uses
+(``deepspeed/runtime/pipe/module.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+
+class DSModule:
+    """Base class for deepspeed_tpu model families (see ``deepspeed_tpu/models``)."""
+
+    def init(self, rng, batch) -> Any:
+        raise NotImplementedError
+
+    def apply(self, params, batch, *, rngs=None, train: bool = True):
+        """Return ``loss`` or ``(loss, aux_dict)``."""
+        raise NotImplementedError
+
+    def tp_partition_rules(self, params_shapes=None) -> Optional[Any]:
+        """Optional pytree of PartitionSpec carrying tensor/model-parallel axes."""
+        return None
+
+
+class _FlaxAdapter(DSModule):
+    def __init__(self, module, loss_fn: Optional[Callable] = None):
+        import inspect
+
+        self.module = module
+        self.loss_fn = loss_fn
+        # Forward the train flag under whichever name the module's __call__
+        # takes ('train' or flax-style 'deterministic'); drop it otherwise.
+        self._train_kwarg = None
+        try:
+            names = set(inspect.signature(type(module).__call__).parameters)
+            if "train" in names:
+                self._train_kwarg = "train"
+            elif "deterministic" in names:
+                self._train_kwarg = "deterministic"
+        except (TypeError, ValueError):
+            pass
+
+    def _inputs(self, batch) -> Tuple[tuple, dict]:
+        if isinstance(batch, dict):
+            return (), batch
+        if isinstance(batch, (tuple, list)):
+            return tuple(batch), {}
+        return (batch,), {}
+
+    def init(self, rng, batch):
+        args, kwargs = self._inputs(batch)
+        if self.loss_fn is not None and isinstance(batch, (tuple, list)) and len(batch) == 2:
+            # (inputs, labels) convention: the module sees only inputs
+            args, kwargs = (batch[0],), {}
+        variables = self.module.init(rng, *args, **kwargs)
+        return variables
+
+    def apply(self, params, batch, *, rngs=None, train: bool = True):
+        args, kwargs = self._inputs(batch)
+        labels = None
+        if self.loss_fn is not None and isinstance(batch, (tuple, list)) and len(batch) == 2:
+            args, kwargs = (batch[0],), {}
+            labels = batch[1]
+        if self._train_kwarg == "train":
+            kwargs["train"] = train
+        elif self._train_kwarg == "deterministic":
+            kwargs["deterministic"] = not train
+        out = self.module.apply(params, *args, **kwargs, rngs=rngs)
+        if self.loss_fn is not None:
+            return self.loss_fn(out, labels if labels is not None else batch)
+        return out
+
+
+class _FunctionalAdapter(DSModule):
+    def __init__(self, init_fn: Callable, apply_fn: Callable, tp_rules: Optional[Callable] = None):
+        import inspect
+
+        self._init = init_fn
+        self._apply = apply_fn
+        self._tp_rules = tp_rules
+        try:
+            sig = inspect.signature(apply_fn)
+            names = set(sig.parameters)
+            has_varkw = any(p.kind == inspect.Parameter.VAR_KEYWORD for p in sig.parameters.values())
+            self._apply_kwargs = has_varkw or {"rngs", "train"} <= names
+        except (TypeError, ValueError):
+            self._apply_kwargs = False
+
+    def init(self, rng, batch):
+        return self._init(rng, batch)
+
+    def apply(self, params, batch, *, rngs=None, train: bool = True):
+        if self._apply_kwargs:
+            return self._apply(params, batch, rngs=rngs, train=train)
+        return self._apply(params, batch)
+
+    def tp_partition_rules(self, params_shapes=None):
+        if self._tp_rules is None:
+            return None
+        return self._tp_rules(params_shapes)
+
+
+def wrap_module(model, loss_fn: Optional[Callable] = None) -> DSModule:
+    if isinstance(model, DSModule):
+        return model
+    if isinstance(model, (tuple, list)) and len(model) == 2 and all(callable(f) for f in model):
+        return _FunctionalAdapter(model[0], model[1])
+    # Flax linen module duck-typing
+    if hasattr(model, "init") and hasattr(model, "apply"):
+        return _FlaxAdapter(model, loss_fn)
+    raise TypeError(
+        f"Cannot adapt {type(model)} into a trainable module: expected a DSModule, "
+        "a Flax module, or an (init_fn, apply_fn) pair"
+    )

@@ -1,0 +1,62 @@
+"""Pipeline engine.
+
+Counterpart of the reference's ``PipelineEngine``
+(``deepspeed/runtime/pipe/engine.py:54``) and its instruction schedule
+(``deepspeed/runtime/pipe/schedule.py``). Round-1 scope: the engine accepts a
+``PipelineModule`` and trains it with the standard fused step — on TPU a
+1-stage pipeline (pipe mesh axis = 1) is exactly the dense engine, and the
+layer sequence runs as one XLA program. ``train_batch``/``eval_batch``
+(reference :297/:404) are provided so user loops port unchanged.
+
+The pipe-axis>1 path (microbatch interleave via ``shard_map`` over the
+``pipe`` axis with ``ppermute`` stage handoffs — the 1F1B schedule expressed
+as a ``lax.scan`` over microbatches) is staged in
+``deepspeed_tpu/runtime/pipe/schedule.py`` and wired up when the pipe axis is
+enabled; until then a pipe axis > 1 raises rather than silently misplacing
+layers.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class PipelineEngine(DeepSpeedEngine):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        if self.topology.get_pipe_parallel_world_size() > 1:
+            raise NotImplementedError(
+                "pipe mesh axis > 1: the scan/ppermute 1F1B schedule is not wired up yet; "
+                "run with mesh.pipe=1 (layers execute as one fused XLA program)"
+            )
+        self.micro_batches = self.gradient_accumulation_steps()
+        log_dist(
+            f"PipelineEngine: {len(self.module.layer_specs)} layers, "
+            f"{self.micro_batches} microbatches/step",
+            ranks=[0],
+        )
+
+    def train_batch(self, data_iter=None, batch=None):
+        """Full pipeline step: gas microbatches + optimizer step
+        (reference pipe/engine.py:297)."""
+        self.train()
+        return super().train_batch(data_iter=data_iter, batch=batch)
+
+    def eval_batch(self, data_iter=None, batch=None, return_logits: bool = False):  # noqa: ARG002
+        self.eval()
+        b = next(data_iter) if batch is None else batch
+        out = self.forward(b)
+        self.train()
+        return out
+
+    def set_dataloader(self, loader) -> None:
+        self.training_dataloader = loader
+
+    def is_first_stage(self) -> bool:
+        return True
+
+    def is_last_stage(self) -> bool:
+        return True

@@ -1,0 +1,180 @@
+"""Pipeline module: a model described as a list of layer specs.
+
+Counterpart of the reference's ``PipelineModule``
+(``deepspeed/runtime/pipe/module.py:86``): users express the network as a
+sequence of ``LayerSpec``s; the module partitions the sequence into
+``num_stages`` contiguous stages (uniform / parameter-balanced / type-regex,
+module.py:368) and tied layers share weights across stages
+(``TiedLayerSpec`` :77).
+
+TPU-native semantics: a stage is a *function segment*, not a process — the
+pipeline engine shards the layer sequence over the ``pipe`` mesh axis and
+microbatches flow between neighbor shards via collective permutes instead of
+p2p sends (see ``runtime/pipe/engine.py``).
+
+Each LayerSpec's ``typename`` must be a DSModule-style factory: calling
+``typename(*args, **kwargs)`` yields an object with ``init(rng, x)`` and
+``apply(params, x, train=...)`` (a Flax module also works — adapted on build).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+from deepspeed_tpu.runtime.module import DSModule
+from deepspeed_tpu.utils.logging import logger
+
+
+class LayerSpec:
+    def __init__(self, typename: Callable, *module_args, **module_kwargs):
+        self.typename = typename
+        self.module_args = module_args
+        self.module_kwargs = module_kwargs
+
+    def build(self, log: bool = False):
+        if log:
+            logger.info(f"Building {repr(self)}")
+        return self.typename(*self.module_args, **self.module_kwargs)
+
+    def __repr__(self) -> str:
+        name = getattr(self.typename, "__name__", str(self.typename))
+        return f"LayerSpec({name})"
+
+
+class TiedLayerSpec(LayerSpec):
+    def __init__(self, key: str, typename: Callable, *module_args, forward_fn=None, tied_weight_attr="embedding", **module_kwargs):
+        super().__init__(typename, *module_args, **module_kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+        self.tied_weight_attr = tied_weight_attr
+
+
+def _count_params(layer) -> int:
+    """Best-effort parameter count for balance partitioning."""
+    try:
+        import jax
+
+        shapes = jax.eval_shape(lambda r: layer.init(r, None), jax.random.PRNGKey(0))
+        return sum(int(np.prod(s.shape)) for s in jax.tree_util.tree_leaves(shapes))
+    except Exception:
+        return 1
+
+
+def partition_uniform(num_items: int, num_parts: int) -> List[int]:
+    parts = [0] * (num_parts + 1)
+    chunk = num_items // num_parts
+    residual = num_items % num_parts
+    for p in range(num_parts):
+        parts[p + 1] = parts[p] + chunk + (1 if p < residual else 0)
+    return parts
+
+
+def partition_balanced(weights: Sequence[float], num_parts: int) -> List[int]:
+    """Prefix-sum balanced contiguous partition (reference ds_utils.partition_balanced)."""
+    n = len(weights)
+    prefix = [0.0]
+    for w in weights:
+        prefix.append(prefix[-1] + w)
+    total = prefix[-1]
+    parts = [0]
+    for p in range(1, num_parts):
+        target = total * p / num_parts
+        # find the index whose prefix is closest to target, monotone
+        lo = parts[-1]
+        best, best_d = lo, float("inf")
+        for i in range(lo, n + 1):
+            d = abs(prefix[i] - target)
+            if d <= best_d:
+                best, best_d = i, d
+            else:
+                break
+        parts.append(best)
+    parts.append(n)
+    return parts
+
+
+class PipelineModule(DSModule):
+    def __init__(
+        self,
+        layers: Sequence[LayerSpec],
+        num_stages: Optional[int] = None,
+        topology=None,
+        loss_fn: Optional[Callable] = None,
+        seed_layers: bool = False,
+        partition_method: str = "parameters",
+        activation_checkpoint_interval: int = 0,
+        checkpointable_layers=None,  # noqa: ARG002 - API parity
+    ):
+        self.layer_specs = [l if isinstance(l, LayerSpec) else LayerSpec(lambda m=l: m) for l in layers]
+        self.num_stages = num_stages
+        self.topology = topology
+        self.loss_fn = loss_fn
+        self.seed_layers = seed_layers
+        self.partition_method = partition_method
+        self.activation_checkpoint_interval = activation_checkpoint_interval
+        self._layers = None
+        self._parts: Optional[List[int]] = None
+
+    # --- construction ---------------------------------------------------
+    def build_layers(self) -> List[Any]:
+        if self._layers is None:
+            self._layers = [spec.build() for spec in self.layer_specs]
+        return self._layers
+
+    def partition(self, num_stages: int) -> List[int]:
+        """Stage boundaries as indices into the layer list."""
+        if self._parts is not None and len(self._parts) == num_stages + 1:
+            return self._parts
+        method = self.partition_method.lower()
+        n = len(self.layer_specs)
+        if method in ("uniform",):
+            self._parts = partition_uniform(n, num_stages)
+        elif method in ("parameters",):
+            layers = self.build_layers()
+            weights = [max(_count_params(l), 1) for l in layers]
+            self._parts = partition_balanced(weights, num_stages)
+        elif method.startswith("type:"):
+            pattern = method.split(":", 1)[1]
+            weights = [
+                1 if re.search(pattern, getattr(s.typename, "__name__", ""), re.IGNORECASE) else 0
+                for s in self.layer_specs
+            ]
+            if sum(weights) == 0:
+                raise ValueError(f"no layers match partition pattern {pattern!r}")
+            self._parts = partition_balanced(weights, num_stages)
+        else:
+            raise NotImplementedError(f"partition method {self.partition_method!r}")
+        return self._parts
+
+    # --- DSModule surface (whole-network; pipeline engine slices stages) --
+    def init(self, rng, batch):
+        import jax
+        import jax.numpy as jnp
+
+        layers = self.build_layers()
+        params = []
+        x = batch[0] if isinstance(batch, (tuple, list)) and len(batch) == 2 else batch
+        for layer in layers:
+            rng, sub = jax.random.split(rng)
+            p = layer.init(sub, x)
+            params.append(p)
+            # thread the next layer's input as zeros of the right shape — a
+            # ShapeDtypeStruct is not a runnable value, so materialize it
+            out_shape = jax.eval_shape(lambda pp, xx, l=layer: l.apply(pp, xx, train=True), p, x)
+            x = jax.tree_util.tree_map(lambda s: jnp.zeros(s.shape, s.dtype), out_shape)
+        return params
+
+    def apply(self, params, batch, *, rngs=None, train: bool = True):
+        layers = self.build_layers()
+        if isinstance(batch, (tuple, list)) and len(batch) == 2:
+            x, labels = batch
+        else:
+            x, labels = batch, None
+        for p, layer in zip(params, layers):
+            x = layer.apply(p, x, train=train)
+        if self.loss_fn is not None and labels is not None:
+            return self.loss_fn(x, labels)
+        return x

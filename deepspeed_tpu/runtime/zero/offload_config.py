@@ -1,0 +1,44 @@
+"""Offload configs (reference: ``deepspeed/runtime/zero/offload_config.py``).
+
+On TPU-VMs, "cpu" offload means host-DRAM partitions driven by the C++ host
+optimizer; "nvme" means the local SSD via the async-IO library
+(``deepspeed_tpu/ops/aio``).
+"""
+
+from enum import Enum
+from pathlib import Path
+from typing import Optional
+
+from pydantic import Field
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel, pp_int
+
+
+class OffloadDeviceEnum(str, Enum):
+    none = "none"
+    cpu = "cpu"
+    nvme = "nvme"
+
+
+class DeepSpeedZeroOffloadParamConfig(DeepSpeedConfigModel):
+    device: OffloadDeviceEnum = OffloadDeviceEnum.none
+    nvme_path: Optional[Path] = None
+    buffer_count: int = Field(5, ge=0)
+    buffer_size: int = Field(pp_int(int(1e8)), ge=0)
+    max_in_cpu: int = Field(pp_int(int(1e9)), ge=0)
+    pin_memory: bool = False
+
+
+class DeepSpeedZeroOffloadOptimizerConfig(DeepSpeedConfigModel):
+    device: OffloadDeviceEnum = OffloadDeviceEnum.none
+    nvme_path: Optional[Path] = None
+    buffer_count: int = Field(4, ge=0)
+    pin_memory: bool = False
+    pipeline_read: bool = False
+    pipeline_write: bool = False
+    fast_init: bool = False
+    ratio: float = Field(1.0, ge=0.0, le=1.0)
+
+    @property
+    def pipeline(self) -> bool:
+        return self.pipeline_read or self.pipeline_write

@@ -1,0 +1,94 @@
+"""Communication logging.
+
+Counterpart of the reference's ``deepspeed/utils/comms_logging.py``
+(``CommsLogger`` with per-op records and ``get_bw`` utilization calc). Records
+are kept per (op_name, msg_size); ``log_summary`` prints the aggregate table.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List
+
+from deepspeed_tpu.utils.logging import log_dist
+
+
+def get_caller_func(frame: int = 3) -> str:
+    import sys
+
+    return sys._getframe(frame).f_code.co_name
+
+
+def calc_bw_log(comm_op: str, size: int, duration: float, n_links: int = 1) -> tuple:
+    """Return (msg_size, algbw GB/s, busbw GB/s) for a collective.
+
+    Bus-bandwidth factors follow the standard NCCL-style accounting: allreduce
+    moves 2(n-1)/n of the data per link, all_gather/reduce_scatter (n-1)/n.
+    """
+    duration = max(duration, 1e-9)
+    n = max(n_links, 1)
+    if comm_op in ("all_reduce", "allreduce", "inference_all_reduce"):
+        tput = 2 * size / duration
+        busbw = (size / duration) * (2 * (n - 1) / n)
+    elif comm_op in ("all_gather", "all_gather_into_tensor", "reduce_scatter", "reduce_scatter_tensor"):
+        size *= n
+        tput = size / duration
+        busbw = (size / duration) * ((n - 1) / n)
+    else:
+        tput = size / duration
+        busbw = tput
+    return size, tput / 1e9, busbw / 1e9
+
+
+class CommsLogger:
+    def __init__(self, verbose: bool = False, debug: bool = False, prof_ops: List[str] = None):
+        self.verbose = verbose
+        self.debug = debug
+        self.prof_ops = prof_ops or []
+        self.comms_dict: Dict[str, Dict[int, List[float]]] = {}
+        self.enabled = False
+
+    def configure(self, comms_config) -> None:
+        self.enabled = getattr(comms_config, "comms_logger_enabled", False)
+        if self.enabled:
+            cfg = comms_config.comms_logger
+            self.verbose = cfg.verbose
+            self.debug = cfg.debug
+            self.prof_ops = cfg.prof_ops
+            self.prof_all = cfg.prof_all
+        else:
+            self.prof_all = False
+
+    def start_profiling_comms(self):
+        self.prof_all = True
+
+    def stop_profiling_comms(self):
+        self.prof_all = False
+
+    def append(self, raw_name: str, record_name: str, latency: float, msg_size: int) -> None:
+        rec = self.comms_dict.setdefault(record_name, {})
+        sizes = rec.setdefault(msg_size, [0, 0.0, []])
+        sizes[0] += 1
+        sizes[1] += latency
+        sizes[2].append(latency)
+        if self.verbose:
+            log_dist(f"comm op: {record_name} | time (ms): {latency:.2f} | msg size: {msg_size}", ranks=[0])
+
+    def log_all(self, print_log: bool = True, show_straggler: bool = False) -> Dict:  # noqa: ARG002
+        lines = [f"{'Comm. Op':<20}{'Message Size':>15}{'Count':>10}{'Total Latency(ms)':>20}{'Avg Latency(ms)':>18}"]
+        for record_name, sizes in sorted(self.comms_dict.items()):
+            lines.append(record_name)
+            for size, (count, total, _samples) in sorted(sizes.items()):
+                avg = total / count if count else 0.0
+                lines.append(f"{'':<20}{_fmt_size(size):>15}{count:>10}{total:>20.2f}{avg:>18.2f}")
+        if print_log:
+            log_dist("\n".join(lines), ranks=[0])
+        return self.comms_dict
+
+
+def _fmt_size(num: int) -> str:
+    if num <= 0:
+        return "0 B"
+    units = ["B", "KB", "MB", "GB", "TB"]
+    p = min(int(math.log(num, 1024)), len(units) - 1)
+    return f"{num / 1024 ** p:.2f} {units[p]}"

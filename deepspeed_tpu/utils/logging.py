@@ -1,0 +1,88 @@
+"""Rank-aware logging.
+
+TPU-native counterpart of the reference's ``deepspeed/utils/logging.py``:
+a module-level ``logger`` plus ``log_dist`` that filters by process index.
+On TPU there is one process per host (not per device), so "rank" here is
+``jax.process_index()``.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+import sys
+
+LOG_LEVELS = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+
+class _LoggerFactory:
+    @staticmethod
+    def create_logger(name: str = "DeepSpeedTPU", level: int = logging.INFO) -> logging.Logger:
+        formatter = logging.Formatter(
+            "[%(asctime)s] [%(levelname)s] [%(filename)s:%(lineno)d:%(funcName)s] %(message)s"
+        )
+        lg = logging.getLogger(name)
+        lg.setLevel(level)
+        lg.propagate = False
+        if not lg.handlers:
+            handler = logging.StreamHandler(stream=sys.stdout)
+            handler.setFormatter(formatter)
+            lg.addHandler(handler)
+        return lg
+
+
+logger = _LoggerFactory.create_logger(
+    level=LOG_LEVELS.get(os.environ.get("DSTPU_LOG_LEVEL", "info").lower(), logging.INFO)
+)
+
+
+@functools.lru_cache(None)
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def log_dist(message: str, ranks=None, level: int = logging.INFO) -> None:
+    """Log ``message`` only on the listed process ranks (``[-1]`` or None = all)."""
+    my_rank = _process_index()
+    if ranks is None or len(ranks) == 0 or -1 in ranks or my_rank in ranks:
+        logger.log(level, f"[Rank {my_rank}] {message}")
+
+
+def print_rank_0(message: str) -> None:
+    if _process_index() == 0:
+        print(message, flush=True)
+
+
+def warning_once(message: str) -> None:
+    _warn_once_impl(message)
+
+
+@functools.lru_cache(None)
+def _warn_once_impl(message: str) -> None:
+    logger.warning(message)
+
+
+def get_current_level() -> int:
+    return logger.getEffectiveLevel()
+
+
+def should_log_le(max_log_level_str: str) -> bool:
+    """True if the logger's level is <= the given level name (i.e. it would emit it)."""
+    if not isinstance(max_log_level_str, str):
+        raise ValueError("max_log_level_str must be a string")
+    level = LOG_LEVELS.get(max_log_level_str.lower())
+    if level is None:
+        raise ValueError(f"unknown log level: {max_log_level_str}")
+    return get_current_level() <= level

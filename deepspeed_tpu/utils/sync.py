@@ -1,0 +1,17 @@
+"""Device synchronization barrier (the CUDA-event/stream-sync analog).
+
+JAX dispatch is async; blocking on a trivial computation drains the default
+device's queue. Single source of truth used by timers, accelerator streams,
+and accelerator.synchronize.
+"""
+
+from __future__ import annotations
+
+
+def device_sync() -> None:
+    try:
+        import jax
+
+        (jax.device_put(0.0) + 0).block_until_ready()
+    except Exception:
+        pass

@@ -1,0 +1,156 @@
+"""Wall-clock timers.
+
+Counterpart of ``deepspeed/utils/timer.py`` (``SynchronizedWallClockTimer``,
+``ThroughputTimer``). "Synchronized" here means blocking on JAX async dispatch
+before reading the clock (the CUDA-event analog).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+FORWARD_MICRO_TIMER = "fwd_microstep"
+FORWARD_GLOBAL_TIMER = "fwd"
+BACKWARD_MICRO_TIMER = "bwd_microstep"
+BACKWARD_GLOBAL_TIMER = "bwd"
+STEP_MICRO_TIMER = "step_microstep"
+STEP_GLOBAL_TIMER = "step"
+
+
+from deepspeed_tpu.utils.sync import device_sync as _sync
+
+
+class SynchronizedWallClockTimer:
+    class Timer:
+        def __init__(self, name: str):
+            self.name = name
+            self.started = False
+            self.start_time = 0.0
+            self.elapsed_ = 0.0
+            self.record = []
+
+        def start(self, sync: bool = False):
+            if sync:
+                _sync()
+            self.start_time = time.perf_counter()
+            self.started = True
+
+        def stop(self, sync: bool = True, record: bool = False):
+            if not self.started:
+                return
+            if sync:
+                _sync()
+            self.elapsed_ += time.perf_counter() - self.start_time
+            self.started = False
+            if record:
+                self.record.append(self.elapsed_)
+
+        def reset(self):
+            self.elapsed_ = 0.0
+            self.started = False
+
+        def elapsed(self, reset: bool = True) -> float:
+            out = self.elapsed_
+            if reset:
+                self.reset()
+            return out
+
+        def mean(self) -> float:
+            return sum(self.record) / len(self.record) if self.record else 0.0
+
+    def __init__(self):
+        self.timers: Dict[str, SynchronizedWallClockTimer.Timer] = {}
+
+    def __call__(self, name: str) -> "SynchronizedWallClockTimer.Timer":
+        if name not in self.timers:
+            self.timers[name] = self.Timer(name)
+        return self.timers[name]
+
+    def log(self, names: List[str], normalizer: float = 1.0, reset: bool = True, memory_breakdown=None, ranks=None):  # noqa: ARG002
+        from deepspeed_tpu.utils.logging import log_dist
+
+        parts = []
+        for name in names:
+            if name in self.timers:
+                elapsed = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                parts.append(f"{name}: {elapsed:.2f}")
+        log_dist("time (ms) | " + " | ".join(parts), ranks=ranks or [0])
+
+    def get_mean(self, names: List[str], normalizer: float = 1.0) -> Dict[str, float]:
+        return {n: self.timers[n].mean() * 1000.0 / normalizer for n in names if n in self.timers}
+
+
+class NoopTimer:
+    class Timer:
+        def start(self, *a, **k):
+            pass
+
+        def stop(self, *a, **k):
+            pass
+
+        def reset(self):
+            pass
+
+        def elapsed(self, *a, **k):
+            return 0.0
+
+    def __call__(self, name):  # noqa: ARG002
+        return self.Timer()
+
+    def log(self, *a, **k):
+        pass
+
+
+class ThroughputTimer:
+    def __init__(self, batch_size: int, start_step: int = 2, steps_per_output: int = 50, monitor_memory: bool = False, logging_fn=None):
+        self.batch_size = max(batch_size, 1)
+        self.start_step = start_step
+        self.steps_per_output = steps_per_output
+        self.monitor_memory = monitor_memory
+        self.logging = logging_fn
+        self.epoch_count = 0
+        self.micro_step_count = 0
+        self.global_step_count = 0
+        self.total_elapsed_time = 0.0
+        self.step_elapsed_time = 0.0
+        self.started = False
+        self.start_time = 0.0
+        self.initialized = False
+
+    def update_epoch_count(self):
+        self.epoch_count += 1
+        self.micro_step_count = 0
+
+    def start(self):
+        self.started = True
+        if self.global_step_count >= self.start_step:
+            _sync()
+            self.start_time = time.perf_counter()
+
+    def stop(self, global_step: bool = False, report_speed: bool = True):
+        if not self.started:
+            return
+        self.started = False
+        self.micro_step_count += 1
+        if global_step:
+            self.global_step_count += 1
+        if self.start_time and self.global_step_count > self.start_step:
+            _sync()
+            duration = time.perf_counter() - self.start_time
+            self.total_elapsed_time += duration
+            self.step_elapsed_time += duration
+            if global_step and report_speed and self.logging and self.global_step_count % self.steps_per_output == 0:
+                self.logging(
+                    f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
+                    f"global_step={self.global_step_count}, RunningAvgSamplesPerSec={self.avg_samples_per_sec():.2f}, "
+                    f"CurrSamplesPerSec={self.batch_size / self.step_elapsed_time:.2f}"
+                )
+            if global_step:
+                self.step_elapsed_time = 0.0
+
+    def avg_samples_per_sec(self) -> float:
+        if self.total_elapsed_time > 0 and self.global_step_count > self.start_step:
+            samples = self.batch_size * (self.global_step_count - self.start_step)
+            return samples / self.total_elapsed_time
+        return 0.0

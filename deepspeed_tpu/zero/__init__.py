@@ -1,0 +1,103 @@
+"""zero namespace (reference: ``deepspeed/runtime/zero/__init__.py`` re-exports).
+
+``zero.Init`` and ``GatheredParameters`` exist in the reference because eager
+PyTorch must physically partition/gather tensors around construction and use
+(``partition_parameters.py:709,1938``). Under GSPMD the partitioner owns data
+movement, so both are cheap context managers that carry intent:
+
+* ``Init`` — records that models built inside should be initialized directly
+  into sharded buffers (the engine already does this for every model via
+  jitted init with sharded out-shardings, so the context is a no-op marker
+  kept for API compatibility).
+* ``GatheredParameters`` — yields fully-replicated host views of requested
+  params for user-side surgery, writing modifications back on exit when
+  ``modifier_rank`` is set.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Optional
+
+import jax
+
+from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig, ZeroStageEnum
+from deepspeed_tpu.runtime.zero.offload_config import (
+    DeepSpeedZeroOffloadOptimizerConfig,
+    DeepSpeedZeroOffloadParamConfig,
+    OffloadDeviceEnum,
+)
+from deepspeed_tpu.runtime.zero.partition import ZeroPartitioner, estimate_zero_memory
+
+_init_ctx_active = False
+
+
+class Init(contextlib.AbstractContextManager):
+    """API-parity context (reference zero.Init, partition_parameters.py:709)."""
+
+    def __init__(self, module=None, data_parallel_group=None, mem_efficient_linear=True,
+                 remote_device=None, pin_memory=False, config_dict_or_path=None,
+                 config=None, enabled=True, dtype=None, mpu=None):  # noqa: ARG002
+        self.enabled = enabled
+
+    def __enter__(self):
+        global _init_ctx_active
+        if self.enabled:
+            _init_ctx_active = True
+        return self
+
+    def __exit__(self, *exc):
+        global _init_ctx_active
+        _init_ctx_active = False
+        return False
+
+
+def is_init_context_active() -> bool:
+    return _init_ctx_active
+
+
+def shutdown_init_context() -> None:
+    global _init_ctx_active
+    _init_ctx_active = False
+
+
+class GatheredParameters(contextlib.AbstractContextManager):
+    """Yield replicated views of sharded params (reference :1938).
+
+    ``params`` is a pytree of jax.Arrays (possibly sharded). On enter, each is
+    fully gathered to a host numpy array; on exit with ``modifier_rank`` set,
+    mutated values are pushed back with the original shardings via the
+    ``write_back`` callback provided by the engine.
+    """
+
+    def __init__(self, params: Any, modifier_rank: Optional[int] = None, fwd_module=None, enabled: bool = True, write_back=None):  # noqa: ARG002
+        self.params = params
+        self.modifier_rank = modifier_rank
+        self.enabled = enabled
+        self.write_back = write_back
+        self.gathered = None
+
+    def __enter__(self):
+        if not self.enabled:
+            return self.params
+        self.gathered = jax.tree_util.tree_map(lambda p: jax.device_get(p), self.params)
+        return self.gathered
+
+    def __exit__(self, *exc):
+        if self.enabled and self.modifier_rank is not None and self.write_back is not None:
+            self.write_back(self.gathered)
+        return False
+
+
+__all__ = [
+    "Init",
+    "GatheredParameters",
+    "DeepSpeedZeroConfig",
+    "ZeroStageEnum",
+    "ZeroPartitioner",
+    "estimate_zero_memory",
+    "OffloadDeviceEnum",
+    "DeepSpeedZeroOffloadParamConfig",
+    "DeepSpeedZeroOffloadOptimizerConfig",
+    "shutdown_init_context",
+]
