@@ -1,0 +1,104 @@
+"""Benchmark: GPT-2 125M ZeRO-1 single-chip training throughput (BASELINE
+config 1), printed as one JSON line.
+
+Metric: tokens/sec/chip. ``vs_baseline`` is measured MFU divided by the 0.40
+MFU north-star (BASELINE.json): 1.0 means the target is met on this chip.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def _peak_tflops_bf16() -> float:
+    """Per-chip bf16 peak. v5e (v5 lite): 197 TFLOP/s; fallbacks for others."""
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    table = {
+        "v5 lite": 197e12,
+        "v5e": 197e12,
+        "v5p": 459e12,
+        "v4": 275e12,
+        "v6": 918e12,
+        "cpu": 1e12,  # nominal, keeps the math defined on CPU runs
+    }
+    for key, val in table.items():
+        if key in kind:
+            return val
+    return 197e12
+
+
+def main():
+    import jax
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import TransformerLM, gpt2_config
+
+    seq = 1024
+    micro = 8
+    mcfg = gpt2_config("125m", max_seq_len=seq)
+    model = TransformerLM(mcfg)
+    config = {
+        "train_micro_batch_size_per_gpu": micro,
+        "optimizer": {"type": "adam", "params": {"lr": 3e-4, "weight_decay": 0.01}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 1},
+        "gradient_clipping": 1.0,
+    }
+    engine, _, _, _ = ds.initialize(model=model, config=config, dist_init_required=False)
+    n_chips = max(engine.data_parallel_world_size(), 1)
+
+    rs = np.random.RandomState(0)
+    toks = rs.randint(0, mcfg.vocab_size, (micro * n_chips, seq + 1)).astype(np.int32)
+    batch = {"input_ids": toks[:, :-1], "labels": toks[:, 1:]}
+
+    # NOTE: sync via device_get of a value at the END of the dependency chain
+    # (params feed the next step, so the final fetch waits for every step);
+    # block_until_ready is unreliable on the tunneled backend.
+    def drain():
+        jax.device_get(engine.get_params()["final_norm_scale"])
+
+    # warmup (compile)
+    for _ in range(3):
+        loss = engine(batch)
+        engine.backward(loss)
+        engine.step()
+    drain()
+
+    steps = 20
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = engine(batch)
+        engine.backward(loss)
+        engine.step()
+    drain()
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = micro * n_chips * seq
+    tokens_per_sec = steps * tokens_per_step / dt
+    tokens_per_sec_per_chip = tokens_per_sec / n_chips
+
+    n_params = engine.num_parameters()
+    # 6N per token (fwd+bwd) + attention: 12*L*H*T ≈ 6*L*H*T*2
+    attn_flops_per_token = 12 * mcfg.num_layers * mcfg.hidden_size * seq
+    flops_per_token = 6 * n_params + attn_flops_per_token
+    mfu = tokens_per_sec_per_chip * flops_per_token / _peak_tflops_bf16()
+
+    print(
+        json.dumps(
+            {
+                "metric": "gpt2_125m_zero1_tokens_per_sec_per_chip",
+                "value": round(tokens_per_sec_per_chip, 1),
+                "unit": "tokens/s/chip",
+                "vs_baseline": round(mfu / 0.40, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

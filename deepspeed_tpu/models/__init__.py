@@ -1,0 +1,2 @@
+from deepspeed_tpu.models.config import TransformerConfig, bert_config, gpt2_config, llama_config
+from deepspeed_tpu.models.transformer import TransformerLM, cross_entropy_loss

@@ -1,0 +1,129 @@
+"""Model configs for the built-in transformer families.
+
+One decoder implementation (``models/transformer.py``) parameterized to cover
+the reference's injected model zoo (``deepspeed/module_inject/containers/``:
+gpt2, llama, gptj, gptneox, opt, bloom, megatron): norm type, positional
+scheme, activation, attention variant (MHA/GQA) are all config switches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class TransformerConfig:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    intermediate_size: Optional[int] = None  # default: 4h (gelu) or 8h/3 rounded (swiglu)
+    num_layers: int = 12
+    num_heads: int = 12
+    num_kv_heads: Optional[int] = None  # GQA; None = MHA
+    head_dim: Optional[int] = None
+    max_seq_len: int = 2048
+
+    causal: bool = True  # False = bidirectional (encoder) attention
+    norm: str = "layernorm"  # layernorm | rmsnorm
+    norm_eps: float = 1e-5
+    position: str = "learned"  # learned | rope | alibi | none
+    rope_theta: float = 10000.0
+    activation: str = "gelu"  # gelu | swiglu | relu | geglu
+    tie_embeddings: bool = True
+    attn_dropout: float = 0.0
+    hidden_dropout: float = 0.0
+    use_bias: bool = True  # linear biases (gpt2 yes, llama no)
+    qkv_bias: Optional[bool] = None  # override for qkv projs
+    dtype: str = "bfloat16"  # computation dtype for activations
+
+    # engineering knobs
+    remat: bool = True  # jax.checkpoint each layer
+    remat_policy: str = "nothing_saveable"
+    scan_layers: bool = True  # lax.scan over stacked layer params
+    flash_attention: bool = True  # use the Pallas fused-attention kernel when available (falls back to einsum)
+    sequence_parallel: bool = False  # Ulysses all-to-all attention over the 'sequence' axis
+
+    def __post_init__(self):
+        if self.head_dim is None:
+            self.head_dim = self.hidden_size // self.num_heads
+        if self.num_kv_heads is None:
+            self.num_kv_heads = self.num_heads
+        if self.intermediate_size is None:
+            if self.activation in ("swiglu", "geglu"):
+                # llama convention: 2/3 * 4h rounded to a multiple of 256
+                self.intermediate_size = 256 * round(self.hidden_size * 8 / 3 / 256)
+            else:
+                self.intermediate_size = 4 * self.hidden_size
+        if self.qkv_bias is None:
+            self.qkv_bias = self.use_bias
+
+
+def gpt2_config(size: str = "125m", **overrides) -> TransformerConfig:
+    presets = {
+        "125m": dict(hidden_size=768, num_layers=12, num_heads=12),
+        "350m": dict(hidden_size=1024, num_layers=24, num_heads=16),
+        "1.3b": dict(hidden_size=2048, num_layers=24, num_heads=16),
+        "2.7b": dict(hidden_size=2560, num_layers=32, num_heads=32),
+    }
+    base = dict(
+        vocab_size=50257,
+        max_seq_len=1024,
+        norm="layernorm",
+        position="learned",
+        activation="gelu",
+        use_bias=True,
+        tie_embeddings=True,
+    )
+    base.update(presets[size])
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+def llama_config(size: str = "7b", **overrides) -> TransformerConfig:
+    presets = {
+        "tiny": dict(hidden_size=256, num_layers=4, num_heads=8, vocab_size=32000, max_seq_len=512),
+        "1b": dict(hidden_size=2048, num_layers=22, num_heads=32, num_kv_heads=4, vocab_size=32000),
+        "7b": dict(hidden_size=4096, num_layers=32, num_heads=32, vocab_size=32000, max_seq_len=4096),
+        "13b": dict(hidden_size=5120, num_layers=40, num_heads=40, vocab_size=32000, max_seq_len=4096),
+        "70b": dict(
+            hidden_size=8192,
+            num_layers=80,
+            num_heads=64,
+            num_kv_heads=8,
+            intermediate_size=28672,
+            vocab_size=32000,
+            max_seq_len=4096,
+        ),
+    }
+    base = dict(
+        norm="rmsnorm",
+        norm_eps=1e-5,
+        position="rope",
+        activation="swiglu",
+        use_bias=False,
+        tie_embeddings=False,
+    )
+    base.update(presets[size])
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+def bert_config(size: str = "large", **overrides) -> TransformerConfig:
+    """Encoder config: bidirectional (non-causal) attention."""
+    presets = {
+        "base": dict(hidden_size=768, num_layers=12, num_heads=12),
+        "large": dict(hidden_size=1024, num_layers=24, num_heads=16),
+    }
+    base = dict(
+        vocab_size=30522,
+        max_seq_len=512,
+        causal=False,
+        norm="layernorm",
+        position="learned",
+        activation="gelu",
+        use_bias=True,
+        tie_embeddings=False,
+    )
+    base.update(presets[size])
+    base.update(overrides)
+    return TransformerConfig(**base)

@@ -84,12 +84,13 @@ class _FlaxAdapter(DSModule):
 
 
 class _FunctionalAdapter(DSModule):
-    def __init__(self, init_fn: Callable, apply_fn: Callable, tp_rules: Optional[Callable] = None):
+    def __init__(self, init_fn: Callable, apply_fn: Callable, tp_rules: Optional[Callable] = None, loss_fn: Optional[Callable] = None):
         import inspect
 
         self._init = init_fn
         self._apply = apply_fn
         self._tp_rules = tp_rules
+        self.loss_fn = loss_fn
         try:
             sig = inspect.signature(apply_fn)
             names = set(sig.parameters)
@@ -102,9 +103,20 @@ class _FunctionalAdapter(DSModule):
         return self._init(rng, batch)
 
     def apply(self, params, batch, *, rngs=None, train: bool = True):
+        if self.loss_fn is not None and isinstance(batch, (tuple, list)) and len(batch) == 2:
+            inputs, labels = batch
+            out = (
+                self._apply(params, inputs, rngs=rngs, train=train)
+                if self._apply_kwargs
+                else self._apply(params, inputs)
+            )
+            return self.loss_fn(out, labels)
         if self._apply_kwargs:
             return self._apply(params, batch, rngs=rngs, train=train)
-        return self._apply(params, batch)
+        out = self._apply(params, batch)
+        if self.loss_fn is not None:
+            return self.loss_fn(out, batch)
+        return out
 
     def tp_partition_rules(self, params_shapes=None):
         if self._tp_rules is None:
@@ -112,15 +124,30 @@ class _FunctionalAdapter(DSModule):
         return self._tp_rules(params_shapes)
 
 
+def _is_flax_module(model) -> bool:
+    try:
+        import flax.linen as nn
+
+        return isinstance(model, nn.Module)
+    except ImportError:
+        return False
+
+
 def wrap_module(model, loss_fn: Optional[Callable] = None) -> DSModule:
     if isinstance(model, DSModule):
         return model
-    if isinstance(model, (tuple, list)) and len(model) == 2 and all(callable(f) for f in model):
-        return _FunctionalAdapter(model[0], model[1])
-    # Flax linen module duck-typing
-    if hasattr(model, "init") and hasattr(model, "apply"):
+    if _is_flax_module(model):
         return _FlaxAdapter(model, loss_fn)
+    if isinstance(model, (tuple, list)) and len(model) == 2 and all(callable(f) for f in model):
+        return _FunctionalAdapter(model[0], model[1], loss_fn=loss_fn)
+    # DSModule-protocol object (init(rng, batch) / apply(params, batch, ...))
+    # that doesn't inherit the base class
+    if hasattr(model, "init") and hasattr(model, "apply"):
+        adapter = _FunctionalAdapter(model.init, model.apply, loss_fn=loss_fn)
+        if hasattr(model, "tp_partition_rules"):
+            adapter.tp_partition_rules = model.tp_partition_rules
+        return adapter
     raise TypeError(
         f"Cannot adapt {type(model)} into a trainable module: expected a DSModule, "
-        "a Flax module, or an (init_fn, apply_fn) pair"
+        "a Flax module, an (init_fn, apply_fn) pair, or an object with init/apply"
     )

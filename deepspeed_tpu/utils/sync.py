@@ -12,6 +12,8 @@ def device_sync() -> None:
     try:
         import jax
 
-        (jax.device_put(0.0) + 0).block_until_ready()
+        # device_get round-trips through the runtime; on tunneled backends
+        # block_until_ready alone can return before execution finishes.
+        jax.device_get(jax.device_put(0.0) + 0)
     except Exception:
         pass

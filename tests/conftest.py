@@ -1,0 +1,38 @@
+"""Test harness: force an 8-device virtual CPU mesh BEFORE jax initializes.
+
+Mirrors the reference's distributed-in-one-box strategy (tests/unit/common.py
+``DistributedTest``): multi-chip semantics are exercised on one host. Here a
+single process drives 8 XLA cpu devices through the same GSPMD code paths the
+TPU pod uses (the sitecustomize force-registers the tunneled TPU backend
+unless PALLAS_AXON_POOL_IPS is empty, hence the env dance).
+"""
+
+import os
+
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_topology():
+    """Each test builds its own mesh; reset the singleton between tests."""
+    import deepspeed_tpu.parallel.mesh as mesh_mod
+
+    mesh_mod.reset_topology()
+    yield
+    mesh_mod.reset_topology()
+
+
+@pytest.fixture
+def eight_devices():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return devs

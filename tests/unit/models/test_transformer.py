@@ -1,0 +1,134 @@
+"""Model family tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import TransformerLM, gpt2_config, llama_config
+from deepspeed_tpu.models.transformer import cross_entropy_loss
+
+
+def _batch(vocab, b=4, t=32, seed=0):
+    rs = np.random.RandomState(seed)
+    toks = rs.randint(0, vocab, (b, t + 1)).astype(np.int32)
+    return {"input_ids": toks[:, :-1], "labels": toks[:, 1:]}
+
+
+@pytest.mark.parametrize(
+    "cfg",
+    [
+        llama_config("tiny", num_layers=2),
+        gpt2_config("125m", hidden_size=64, num_layers=2, num_heads=4, vocab_size=256, max_seq_len=64),
+    ],
+    ids=["llama", "gpt2"],
+)
+def test_initial_loss_near_uniform(cfg):
+    model = TransformerLM(cfg)
+    batch = _batch(cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(0), batch)
+    loss = model.apply(params, batch, train=False)
+    expected = np.log(cfg.vocab_size)
+    assert abs(float(loss) - expected) < 1.0
+
+
+def test_scan_matches_unrolled():
+    cfg_scan = llama_config("tiny", num_layers=3, scan_layers=True, remat=False)
+    cfg_loop = llama_config("tiny", num_layers=3, scan_layers=False, remat=False)
+    m1, m2 = TransformerLM(cfg_scan), TransformerLM(cfg_loop)
+    batch = _batch(cfg_scan.vocab_size)
+    params = m1.init(jax.random.PRNGKey(0), batch)
+    l1 = m1.apply(params, batch, train=False)
+    l2 = m2.apply(params, batch, train=False)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+
+
+def test_remat_matches_no_remat():
+    cfg_a = llama_config("tiny", num_layers=2, remat=True, dtype="float32")
+    cfg_b = llama_config("tiny", num_layers=2, remat=False, dtype="float32")
+    batch = _batch(cfg_a.vocab_size)
+    m_a, m_b = TransformerLM(cfg_a), TransformerLM(cfg_b)
+    params = m_a.init(jax.random.PRNGKey(0), batch)
+
+    ga = jax.grad(lambda p: m_a.apply(p, batch, train=False))(params)
+    gb = jax.grad(lambda p: m_b.apply(p, batch, train=False))(params)
+    la, lb = jax.tree_util.tree_leaves(ga), jax.tree_util.tree_leaves(gb)
+    for a, b in zip(la, lb):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)
+
+
+def test_causality():
+    """Future tokens must not affect past logits."""
+    cfg = llama_config("tiny", num_layers=2, remat=False)
+    model = TransformerLM(cfg)
+    rs = np.random.RandomState(0)
+    toks = rs.randint(0, cfg.vocab_size, (1, 16)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(0), toks)
+    logits1 = model.apply(params, toks, train=False)
+    toks2 = toks.copy()
+    toks2[0, -1] = (toks2[0, -1] + 1) % cfg.vocab_size
+    logits2 = model.apply(params, toks2, train=False)
+    np.testing.assert_allclose(
+        np.asarray(logits1[0, :-1]), np.asarray(logits2[0, :-1]), rtol=2e-2, atol=2e-3
+    )
+    assert not np.allclose(np.asarray(logits1[0, -1]), np.asarray(logits2[0, -1]))
+
+
+def test_gqa_shapes():
+    cfg = llama_config("tiny", num_layers=2, num_kv_heads=2, remat=False)
+    model = TransformerLM(cfg)
+    batch = _batch(cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(0), batch)
+    assert params["layers"]["wk"].shape[-1] == 2 * cfg.head_dim
+    loss = model.apply(params, batch, train=False)
+    assert np.isfinite(float(loss))
+
+
+def test_cross_entropy_ignore_index():
+    logits = jnp.zeros((2, 3, 5))
+    labels = jnp.array([[1, 2, -100], [-100, -100, 0]])
+    loss = cross_entropy_loss(logits, labels)
+    np.testing.assert_allclose(float(loss), np.log(5), rtol=1e-6)
+
+
+def test_train_end_to_end_zero3(eight_devices):
+    cfg = llama_config("tiny", num_layers=2)
+    engine, *_ = ds.initialize(
+        model=TransformerLM(cfg),
+        config={
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adam", "params": {"lr": 3e-4}},
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 3},
+            "gradient_clipping": 1.0,
+        },
+    )
+    batch = _batch(cfg.vocab_size, b=8, t=32)
+    losses = []
+    for _ in range(5):
+        loss = engine(batch)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(jax.device_get(loss)))
+    assert losses[-1] < losses[0]
+
+
+def test_tp_sharding_rules_applied(eight_devices):
+    cfg = llama_config("tiny", num_layers=2)
+    engine, *_ = ds.initialize(
+        model=TransformerLM(cfg),
+        config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "adam", "params": {"lr": 3e-4}},
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 0},
+            "mesh": {"model": 2},
+        },
+    )
+    batch = _batch(cfg.vocab_size, b=8, t=32)
+    loss = engine(batch)
+    engine.backward(loss)
+    engine.step()
+    assert "model" in str(engine.get_params()["layers"]["wq"].sharding.spec)
+    assert np.isfinite(float(jax.device_get(loss)))

@@ -1,0 +1,89 @@
+"""Optimizer numerics vs torch reference (reference: tests/unit/ops/adam/)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops import SGD, DeepSpeedCPUAdagrad, FusedAdam, FusedLamb
+from deepspeed_tpu.ops.adam.fused_adam import Adam
+
+
+def _run_ds(opt, params, grads_list, lr):
+    state = opt.init_state(params)
+    p = params
+    for g in grads_list:
+        p, state = jax.jit(opt.apply)(g, state, p, jnp.float32(lr))
+    return p
+
+
+def _torch_params_grads(shape=(7, 9), steps=5, seed=0):
+    rs = np.random.RandomState(seed)
+    p0 = rs.randn(*shape).astype(np.float32)
+    grads = [rs.randn(*shape).astype(np.float32) for _ in range(steps)]
+    return p0, grads
+
+
+@pytest.mark.parametrize("adam_w_mode,weight_decay", [(True, 0.01), (False, 0.01), (True, 0.0)])
+def test_fused_adam_matches_torch(adam_w_mode, weight_decay):
+    torch = pytest.importorskip("torch")
+    p0, grads = _torch_params_grads()
+    lr = 1e-2
+
+    ds_opt = FusedAdam(lr=lr, adam_w_mode=adam_w_mode, weight_decay=weight_decay)
+    ds_final = _run_ds(ds_opt, {"p": jnp.asarray(p0)}, [{"p": jnp.asarray(g)} for g in grads], lr)
+
+    tp = torch.nn.Parameter(torch.tensor(p0))
+    cls = torch.optim.AdamW if adam_w_mode else torch.optim.Adam
+    topt = cls([tp], lr=lr, betas=(0.9, 0.999), eps=1e-8, weight_decay=weight_decay)
+    for g in grads:
+        tp.grad = torch.tensor(g)
+        topt.step()
+    np.testing.assert_allclose(np.asarray(ds_final["p"]), tp.detach().numpy(), rtol=2e-5, atol=2e-6)
+
+
+def test_adagrad_matches_torch():
+    torch = pytest.importorskip("torch")
+    p0, grads = _torch_params_grads()
+    lr = 1e-2
+    ds_opt = DeepSpeedCPUAdagrad(lr=lr, eps=1e-10)
+    ds_final = _run_ds(ds_opt, {"p": jnp.asarray(p0)}, [{"p": jnp.asarray(g)} for g in grads], lr)
+    tp = torch.nn.Parameter(torch.tensor(p0))
+    topt = torch.optim.Adagrad([tp], lr=lr, eps=1e-10)
+    for g in grads:
+        tp.grad = torch.tensor(g)
+        topt.step()
+    np.testing.assert_allclose(np.asarray(ds_final["p"]), tp.detach().numpy(), rtol=2e-5, atol=2e-6)
+
+
+def test_sgd_momentum_matches_torch():
+    torch = pytest.importorskip("torch")
+    p0, grads = _torch_params_grads()
+    lr, mom = 1e-2, 0.9
+    ds_final = _run_ds(SGD(lr=lr, momentum=mom), {"p": jnp.asarray(p0)}, [{"p": jnp.asarray(g)} for g in grads], lr)
+    tp = torch.nn.Parameter(torch.tensor(p0))
+    topt = torch.optim.SGD([tp], lr=lr, momentum=mom)
+    for g in grads:
+        tp.grad = torch.tensor(g)
+        topt.step()
+    np.testing.assert_allclose(np.asarray(ds_final["p"]), tp.detach().numpy(), rtol=2e-5, atol=2e-6)
+
+
+def test_lamb_trust_ratio_bounds():
+    p0, grads = _torch_params_grads()
+    lr = 1e-2
+    opt = FusedLamb(lr=lr, max_coeff=10.0, min_coeff=0.01)
+    final = _run_ds(opt, {"p": jnp.asarray(p0)}, [{"p": jnp.asarray(g)} for g in grads], lr)
+    assert np.isfinite(np.asarray(final["p"])).all()
+    assert not np.allclose(np.asarray(final["p"]), p0)
+
+
+def test_state_specs_congruent():
+    from jax.sharding import PartitionSpec as P
+
+    opt = FusedAdam(lr=1e-3)
+    params = {"a": jnp.zeros((4, 4)), "b": jnp.zeros((2,))}
+    spec_tree = {"a": P("data", None), "b": P(None)}
+    ss = opt.state_specs(spec_tree)
+    assert ss.exp_avg["a"] == P("data", None)
+    assert ss.step == P()

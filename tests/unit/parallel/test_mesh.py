@@ -1,0 +1,39 @@
+"""Mesh/topology tests (reference: tests/unit/runtime/pipe/test_topology.py)."""
+
+import pytest
+
+from deepspeed_tpu.parallel.mesh import AXIS_ORDER, build_mesh, get_topology, initialize_topology
+from deepspeed_tpu.runtime.config import MeshConfig
+
+
+def test_default_topology_all_data(eight_devices):
+    topo = initialize_topology()
+    assert topo.get_data_parallel_world_size() == 8
+    assert topo.mesh.axis_names == AXIS_ORDER
+
+
+def test_mixed_axes(eight_devices):
+    topo = initialize_topology(MeshConfig(model=2, sequence=2))
+    assert topo.get_model_parallel_world_size() == 2
+    assert topo.get_sequence_parallel_world_size() == 2
+    assert topo.get_data_parallel_world_size() == 2
+    assert topo.axis_size("model") == 2
+
+
+def test_expert_regroups_data(eight_devices):
+    topo = initialize_topology(MeshConfig(expert=4))
+    assert topo.get_expert_parallel_world_size() == 4
+    assert topo.get_data_parallel_world_size() == 8  # data(2) x expert(4)
+    assert topo.get_expert_data_parallel_world_size() == 2
+    assert "expert" in topo.data_parallel_axes
+
+
+def test_seq_in_dp_axes(eight_devices):
+    topo = initialize_topology(MeshConfig(sequence=2))
+    assert "sequence" in topo.data_parallel_axes
+    assert topo.get_sequence_data_parallel_world_size() == 8
+
+
+def test_singleton(eight_devices):
+    t1 = get_topology()
+    assert get_topology() is t1

@@ -1,0 +1,93 @@
+"""Config tests (reference: tests/unit/runtime/test_ds_config_dict.py etc.)."""
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError, MeshConfig
+
+
+class TestBatchTriad:
+    def test_all_given_consistent(self):
+        c = DeepSpeedConfig({"train_batch_size": 32, "train_micro_batch_size_per_gpu": 2, "gradient_accumulation_steps": 2})
+        c.resolve_batch_triad(dp_world_size=8)
+        assert (c.train_batch_size, c.train_micro_batch_size_per_gpu, c.gradient_accumulation_steps) == (32, 2, 2)
+
+    def test_all_given_inconsistent(self):
+        c = DeepSpeedConfig({"train_batch_size": 33, "train_micro_batch_size_per_gpu": 2, "gradient_accumulation_steps": 2})
+        with pytest.raises(DeepSpeedConfigError):
+            c.resolve_batch_triad(dp_world_size=8)
+
+    def test_derive_gas(self):
+        c = DeepSpeedConfig({"train_batch_size": 32, "train_micro_batch_size_per_gpu": 2})
+        c.resolve_batch_triad(dp_world_size=8)
+        assert c.gradient_accumulation_steps == 2
+
+    def test_derive_micro(self):
+        c = DeepSpeedConfig({"train_batch_size": 32, "gradient_accumulation_steps": 2})
+        c.resolve_batch_triad(dp_world_size=8)
+        assert c.train_micro_batch_size_per_gpu == 2
+
+    def test_derive_total(self):
+        c = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 4})
+        c.resolve_batch_triad(dp_world_size=2)
+        assert c.train_batch_size == 8
+        assert c.gradient_accumulation_steps == 1
+
+    def test_none_given(self):
+        c = DeepSpeedConfig({})
+        with pytest.raises(DeepSpeedConfigError):
+            c.resolve_batch_triad(dp_world_size=2)
+
+
+class TestPrecisionConfig:
+    def test_fp16_and_bf16_conflict(self):
+        with pytest.raises(DeepSpeedConfigError):
+            DeepSpeedConfig({"fp16": {"enabled": True}, "bf16": {"enabled": True}})
+
+    def test_auto_values_filtered(self):
+        c = DeepSpeedConfig({"train_batch_size": "auto", "train_micro_batch_size_per_gpu": 4})
+        c.resolve_batch_triad(dp_world_size=1)
+        assert c.train_batch_size == 4
+
+    def test_dynamic_loss_scale_args(self):
+        c = DeepSpeedConfig({"fp16": {"enabled": True, "initial_scale_power": 8, "hysteresis": 3}})
+        assert c.dynamic_loss_scale_args["init_scale"] == 256
+        assert c.dynamic_loss_scale_args["delayed_shift"] == 3
+
+    def test_bfloat16_old_key(self):
+        c = DeepSpeedConfig({"bfloat16": {"enabled": True}})
+        assert c.bfloat16_enabled
+
+
+class TestZeroConfig:
+    def test_stage_parse(self):
+        c = DeepSpeedConfig({"zero_optimization": {"stage": 3}})
+        assert c.zero_enabled and c.zero_optimization_stage == 3
+
+    def test_stage_aliases(self):
+        c = DeepSpeedConfig({"zero_optimization": {"stage": 3, "stage3_max_live_parameters": 123}})
+        assert int(c.zero_config.max_live_parameters) == 123
+
+    def test_legacy_cpu_offload(self):
+        c = DeepSpeedConfig({"zero_optimization": {"stage": 2, "cpu_offload": True}})
+        assert c.zero_config.offload_optimizer is not None
+        assert c.zero_config.offload_optimizer.device == "cpu"
+
+    def test_overlap_comm_default(self):
+        assert DeepSpeedConfig({"zero_optimization": {"stage": 3}}).zero_config.overlap_comm
+        assert not DeepSpeedConfig({"zero_optimization": {"stage": 1}}).zero_config.overlap_comm
+
+
+class TestMeshConfig:
+    def test_resolve_data_axis(self):
+        m = MeshConfig(model=2).resolve(8)
+        assert m.data == 4
+
+    def test_indivisible(self):
+        with pytest.raises(DeepSpeedConfigError):
+            MeshConfig(model=3).resolve(8)
+
+    def test_duplicate_key_rejected(self, tmp_path):
+        p = tmp_path / "cfg.json"
+        p.write_text('{"train_batch_size": 1, "train_batch_size": 2}')
+        with pytest.raises(ValueError):
+            DeepSpeedConfig(str(p))

@@ -1,0 +1,74 @@
+"""Dataloader tests."""
+
+import numpy as np
+
+from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader, RepeatingLoader
+
+
+class _ListDataset:
+    def __init__(self, n=20, dim=4):
+        rs = np.random.RandomState(0)
+        self.data = [(rs.randn(dim).astype(np.float32), rs.randn(1).astype(np.float32)) for _ in range(n)]
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, i):
+        return self.data[i]
+
+
+def test_batching_and_len():
+    loader = DeepSpeedDataLoader(_ListDataset(20), batch_size=8)
+    assert len(loader) == 2
+    batches = list(loader)
+    assert len(batches) == 2
+    x, y = batches[0]
+    assert x.shape == (8, 4) and y.shape == (8, 1)
+
+
+def test_no_drop_last():
+    loader = DeepSpeedDataLoader(_ListDataset(20), batch_size=8, drop_last=False)
+    assert len(loader) == 3
+    assert list(loader)[-1][0].shape == (4, 4)
+
+
+def test_shuffle_deterministic_per_epoch():
+    l1 = DeepSpeedDataLoader(_ListDataset(16), batch_size=4, shuffle=True, seed=3)
+    l2 = DeepSpeedDataLoader(_ListDataset(16), batch_size=4, shuffle=True, seed=3)
+    b1, b2 = next(iter(l1)), next(iter(l2))
+    np.testing.assert_array_equal(b1[0], b2[0])
+    l1.set_epoch(1)
+    b3 = next(iter(l1))
+    assert not np.array_equal(b1[0], b3[0])
+
+
+def test_dict_collate():
+    class DictDS:
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            return {"x": np.full(3, i, np.float32), "y": np.int32(i)}
+
+    loader = DeepSpeedDataLoader(DictDS(), batch_size=4)
+    b = next(iter(loader))
+    assert set(b) == {"x", "y"}
+    assert b["x"].shape == (4, 3)
+
+
+def test_repeating_loader():
+    loader = DeepSpeedDataLoader(_ListDataset(8), batch_size=4)
+    rep = RepeatingLoader(loader)
+    batches = [next(rep) for _ in range(5)]
+    assert len(batches) == 5
+
+
+def test_iterable_dataset():
+    def gen():
+        for i in range(10):
+            yield np.full(2, i, np.float32)
+
+    loader = DeepSpeedDataLoader(gen(), batch_size=4)
+    batches = list(loader)
+    assert len(batches) == 2
+    assert batches[0].shape == (4, 2)

@@ -1,0 +1,144 @@
+"""Engine behavior tests (reference: tests/unit/runtime/test_ds_initialize.py)."""
+
+import os
+import tempfile
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from tests.unit.simple_model import SimpleModel, random_dataloader
+
+
+def _cfg(**over):
+    base = {
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 1},
+    }
+    base.update(over)
+    return base
+
+
+def test_initialize_returns_tuple(eight_devices):
+    engine, opt, loader, sched = ds.initialize(model=SimpleModel(), config=_cfg())
+    assert opt is engine.optimizer
+    assert loader is None and sched is None
+
+
+def test_client_optimizer(eight_devices):
+    from deepspeed_tpu.ops import FusedAdam
+
+    opt = FusedAdam(lr=5e-3)
+    engine, returned, *_ = ds.initialize(model=SimpleModel(), config={"train_micro_batch_size_per_gpu": 1, "bf16": {"enabled": True}}, optimizer=opt)
+    assert returned is opt
+    assert engine.get_lr() == [5e-3]
+
+
+def test_lr_scheduler_from_config(eight_devices):
+    cfg = _cfg(scheduler={"type": "WarmupLR", "params": {"warmup_min_lr": 0.0, "warmup_max_lr": 1e-2, "warmup_num_steps": 4}})
+    engine, *_ = ds.initialize(model=SimpleModel(), config=cfg)
+    batch = next(random_dataloader())
+    lrs = []
+    for _ in range(5):
+        loss = engine(batch)
+        engine.backward(loss)
+        engine.step()
+        lrs.append(engine.get_lr()[0])
+    assert lrs[-1] == pytest.approx(1e-2, rel=1e-3)
+    assert lrs[0] < lrs[1] < lrs[2]
+
+
+def test_checkpoint_roundtrip(eight_devices):
+    engine, *_ = ds.initialize(model=SimpleModel(), config=_cfg())
+    batch = next(random_dataloader())
+    for _ in range(3):
+        loss = engine(batch)
+        engine.backward(loss)
+        engine.step()
+    with tempfile.TemporaryDirectory() as d:
+        engine.save_checkpoint(d, client_state={"k": 1})
+        assert os.path.isfile(os.path.join(d, "latest"))
+        w_before = jax.device_get(engine.get_master_params()["w0"])
+
+        import deepspeed_tpu.parallel.mesh as mesh_mod
+
+        mesh_mod.reset_topology()
+        engine2, *_ = ds.initialize(model=SimpleModel(), config=_cfg())
+        engine2.init_params(batch, rng=jax.random.PRNGKey(123))
+        path, client = engine2.load_checkpoint(d)
+        assert client == {"k": 1}
+        assert engine2.global_steps == 3
+        np.testing.assert_array_equal(jax.device_get(engine2.get_master_params()["w0"]), w_before)
+
+
+def test_checkpoint_load_without_latest(eight_devices, tmp_path):
+    engine, *_ = ds.initialize(model=SimpleModel(), config=_cfg())
+    batch = next(random_dataloader())
+    loss = engine(batch)
+    engine.backward(loss)
+    engine.step()
+    path, client = engine.load_checkpoint(str(tmp_path))
+    assert path is None and client == {}
+
+
+def test_eval_mode_no_grad_side_effects(eight_devices):
+    engine, *_ = ds.initialize(model=SimpleModel(), config=_cfg())
+    batch = next(random_dataloader())
+    loss = engine(batch)
+    engine.backward(loss)
+    engine.step()
+    acc_before = jax.device_get(engine._grad_acc["w0"])
+    engine.eval()
+    _ = engine(batch)
+    with pytest.raises(RuntimeError):
+        engine.backward(loss)
+    np.testing.assert_array_equal(jax.device_get(engine._grad_acc["w0"]), acc_before)
+    engine.train()
+
+
+def test_model_parameters_passthrough(eight_devices):
+    model = SimpleModel(16)
+    params = model.init(jax.random.PRNGKey(7), None)
+    engine, *_ = ds.initialize(model=model, config=_cfg(), model_parameters=params)
+    batch = next(random_dataloader(16))
+    engine.init_params(batch)
+    np.testing.assert_allclose(
+        jax.device_get(engine.get_master_params()["w0"]),
+        np.asarray(params["w0"], dtype=np.float32),
+        rtol=1e-6,
+    )
+
+
+def test_fp16_overflow_skips_step(eight_devices):
+    cfg = _cfg(bf16={"enabled": False}, fp16={"enabled": True, "initial_scale_power": 4, "hysteresis": 1})
+    engine, *_ = ds.initialize(model=SimpleModel(), config=cfg)
+    x, y = next(random_dataloader())
+    loss = engine((x, y))
+    engine.backward(loss)
+    engine.step()
+    w_after_good = jax.device_get(engine.get_master_params()["w0"])
+    xn = x.copy()
+    xn[0, 0] = np.inf
+    loss = engine((xn, y))
+    engine.backward(loss)
+    engine.step()
+    assert engine.skipped_steps == 1
+    assert engine.loss_scale == 8.0  # 16 / 2 after overflow with hysteresis=1
+    np.testing.assert_array_equal(jax.device_get(engine.get_master_params()["w0"]), w_after_good)
+
+
+def test_gradient_clipping_applied(eight_devices):
+    engine, *_ = ds.initialize(model=SimpleModel(), config=_cfg(gradient_clipping=1e-8))
+    batch = next(random_dataloader())
+    w_before = jax.device_get(engine.get_master_params()["w0"]) if engine._initialized else None
+    loss = engine(batch)
+    engine.backward(loss)
+    engine.step()
+    norm = engine.get_global_grad_norm()
+    assert norm is not None and norm > 0
+    # with a tiny clip threshold the update must be tiny
+    w_after = jax.device_get(engine.get_master_params()["w0"])
+    assert np.abs(w_after).max() < 1.0

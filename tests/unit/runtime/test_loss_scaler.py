@@ -1,0 +1,78 @@
+"""Loss scaler tests (reference: tests/unit/runtime/half_precision/)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.runtime.fp16.loss_scaler import (
+    CreateLossScaler,
+    DynamicLossScaler,
+    LossScaler,
+    has_inf_or_nan,
+)
+
+
+def _step(scaler, state, overflow: bool):
+    return scaler.update(state, jnp.asarray(overflow))
+
+
+def test_static_scaler_constant():
+    s = LossScaler(scale=128.0)
+    st = s.init_state()
+    st = _step(s, st, True)
+    assert float(st.scale) == 128.0
+
+
+def test_dynamic_shrinks_on_overflow():
+    s = DynamicLossScaler(init_scale=16.0, delayed_shift=1)
+    st = s.init_state()
+    st = _step(s, st, True)
+    assert float(st.scale) == 8.0
+
+
+def test_hysteresis_tolerates_first_overflow():
+    s = DynamicLossScaler(init_scale=16.0, delayed_shift=2)
+    st = s.init_state()
+    st = _step(s, st, True)
+    assert float(st.scale) == 16.0
+    st = _step(s, st, True)
+    assert float(st.scale) == 8.0
+
+
+def test_hysteresis_resets_on_good_step():
+    s = DynamicLossScaler(init_scale=16.0, delayed_shift=2)
+    st = s.init_state()
+    st = _step(s, st, True)  # hysteresis 2 -> 1
+    st = _step(s, st, False)  # resets to 2
+    st = _step(s, st, True)  # 2 -> 1, no shrink
+    assert float(st.scale) == 16.0
+
+
+def test_growth_after_window():
+    s = DynamicLossScaler(init_scale=16.0, scale_window=3, delayed_shift=1)
+    st = s.init_state()
+    for _ in range(3):
+        st = _step(s, st, False)
+    assert float(st.scale) == 32.0
+
+
+def test_min_scale_floor():
+    s = DynamicLossScaler(init_scale=2.0, min_scale=1.0, delayed_shift=1)
+    st = s.init_state()
+    for _ in range(5):
+        st = _step(s, st, True)
+    assert float(st.scale) == 1.0
+
+
+def test_factory_selection():
+    assert CreateLossScaler(jnp.float16, 0, True, {}).dynamic
+    assert not CreateLossScaler(jnp.float16, 128, False, {}).dynamic
+    assert CreateLossScaler(jnp.bfloat16, 0, True, {}).init_scale == 1.0
+
+
+def test_has_inf_or_nan():
+    clean = {"a": jnp.ones((3,)), "b": jnp.zeros((2,))}
+    dirty = {"a": jnp.array([1.0, np.inf]), "b": jnp.zeros((2,))}
+    nan = {"a": jnp.array([np.nan]), "b": jnp.zeros((2,))}
+    assert not bool(has_inf_or_nan(clean))
+    assert bool(has_inf_or_nan(dirty))
+    assert bool(has_inf_or_nan(nan))

@@ -1,0 +1,109 @@
+"""LR schedule tests (reference: tests/unit/runtime/test_lr_schedulers.py)."""
+
+import math
+
+import pytest
+
+from deepspeed_tpu.ops import FusedAdam
+from deepspeed_tpu.runtime.lr_schedules import (
+    LRRangeTest,
+    OneCycle,
+    WarmupCosineLR,
+    WarmupDecayLR,
+    WarmupLR,
+    get_lr_scheduler,
+)
+
+
+def _opt(lr=0.1):
+    return FusedAdam(lr=lr)
+
+
+class TestWarmupLR:
+    def test_linear_warmup(self):
+        opt = _opt()
+        s = WarmupLR(opt, warmup_min_lr=0.0, warmup_max_lr=0.1, warmup_num_steps=10, warmup_type="linear")
+        lrs = []
+        for _ in range(15):
+            s.step()
+            lrs.append(opt.lr)
+        assert lrs[0] == pytest.approx(0.0)
+        assert lrs[4] == pytest.approx(0.04)
+        assert lrs[-1] == pytest.approx(0.1)
+
+    def test_log_warmup_reaches_max(self):
+        opt = _opt()
+        s = WarmupLR(opt, warmup_max_lr=0.1, warmup_num_steps=10)
+        for _ in range(12):
+            s.step()
+        assert opt.lr == pytest.approx(0.1)
+
+
+class TestWarmupDecayLR:
+    def test_decays_to_zero(self):
+        opt = _opt()
+        s = WarmupDecayLR(opt, total_num_steps=20, warmup_max_lr=0.1, warmup_num_steps=5, warmup_type="linear")
+        for _ in range(21):
+            s.step()
+        assert opt.lr == pytest.approx(0.0, abs=1e-9)
+
+    def test_peak_at_warmup_end(self):
+        opt = _opt()
+        s = WarmupDecayLR(opt, total_num_steps=20, warmup_max_lr=0.1, warmup_num_steps=5, warmup_type="linear")
+        peak = 0
+        for _ in range(20):
+            s.step()
+            peak = max(peak, opt.lr)
+        assert peak == pytest.approx(0.1, rel=0.01)
+
+
+class TestWarmupCosineLR:
+    def test_cosine_floor(self):
+        opt = _opt(lr=0.1)
+        s = WarmupCosineLR(opt, total_num_steps=20, warmup_num_steps=5, cos_min_ratio=0.1)
+        for _ in range(25):
+            s.step()
+        assert opt.lr == pytest.approx(0.1 * 0.1, rel=1e-3)
+
+
+class TestOneCycle:
+    def test_triangle(self):
+        opt = _opt()
+        s = OneCycle(opt, cycle_min_lr=0.01, cycle_max_lr=0.1, cycle_first_step_size=10)
+        lrs = []
+        for _ in range(21):
+            s.step()
+            lrs.append(opt.lr)
+        assert max(lrs) == pytest.approx(0.1, rel=0.05)
+        assert lrs[-1] == pytest.approx(0.01, rel=0.3)
+
+
+class TestLRRangeTest:
+    def test_growth(self):
+        opt = _opt()
+        s = LRRangeTest(opt, lr_range_test_min_lr=0.01, lr_range_test_step_size=5, lr_range_test_step_rate=1.0)
+        s.step()
+        first = opt.lr
+        for _ in range(10):
+            s.step()
+        assert opt.lr > first
+
+
+class TestRegistry:
+    def test_get_by_name(self):
+        s = get_lr_scheduler("WarmupLR", _opt(), warmup_max_lr=0.5)
+        assert isinstance(s, WarmupLR)
+
+    def test_unknown_raises(self):
+        with pytest.raises(ValueError):
+            get_lr_scheduler("Nope", _opt())
+
+    def test_state_dict_roundtrip(self):
+        opt = _opt()
+        s = WarmupLR(opt, warmup_max_lr=0.1, warmup_num_steps=10)
+        for _ in range(5):
+            s.step()
+        sd = s.state_dict()
+        s2 = WarmupLR(_opt(), warmup_max_lr=0.1, warmup_num_steps=10)
+        s2.load_state_dict(sd)
+        assert s2.last_batch_iteration == s.last_batch_iteration

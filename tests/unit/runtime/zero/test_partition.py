@@ -1,0 +1,50 @@
+"""ZeRO spec-emission unit tests."""
+
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.parallel.mesh import initialize_topology
+from deepspeed_tpu.runtime.config import MeshConfig
+from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+from deepspeed_tpu.runtime.zero.partition import ZeroPartitioner, shard_over_zero_axes
+
+
+def _topo(**kw):
+    return initialize_topology(MeshConfig(**kw))
+
+
+def test_shards_largest_divisible_dim(eight_devices):
+    topo = _topo()
+    spec = shard_over_zero_axes((16, 64), topo)
+    assert spec == P(None, "data")
+
+
+def test_below_threshold_replicated(eight_devices):
+    topo = _topo()
+    spec = shard_over_zero_axes((16, 64), topo, threshold=10_000)
+    assert spec == P(None, None)
+
+
+def test_indivisible_replicated(eight_devices):
+    topo = _topo()
+    spec = shard_over_zero_axes((3, 5), topo)
+    assert spec == P(None, None)
+
+
+def test_respects_tp_axes(eight_devices):
+    topo = _topo(model=2)
+    spec = shard_over_zero_axes((64, 64), topo, base_spec=P(None, "model"))
+    assert spec == P("data", "model")
+
+
+def test_stage_selection(eight_devices):
+    topo = _topo()
+    params = {"w": np.zeros((64, 64), np.float32)}
+    for stage, param_sharded, grad_sharded in [(0, False, False), (1, False, False), (2, False, True), (3, True, True)]:
+        part = ZeroPartitioner(DeepSpeedZeroConfig(stage=stage, stage3_param_persistence_threshold=0), topo)
+        ps = part.param_specs(params)["w"]
+        gs = part.grad_accum_specs(params)["w"]
+        ms = part.master_specs(params)["w"]
+        assert ("data" in str(ps)) == param_sharded, f"stage {stage} param"
+        assert ("data" in str(gs)) == grad_sharded, f"stage {stage} grad"
+        assert ("data" in str(ms)) == (stage >= 1), f"stage {stage} master"

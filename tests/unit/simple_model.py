@@ -1,0 +1,47 @@
+"""Test model fixtures (reference: ``tests/unit/simple_model.py``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class SimpleModel:
+    """MLP regression model (reference SimpleModel :18)."""
+
+    def __init__(self, hidden_dim: int = 16, nlayers: int = 2):
+        self.hidden_dim = hidden_dim
+        self.nlayers = nlayers
+
+    def init(self, rng, batch):
+        params = {}
+        for i in range(self.nlayers):
+            rng, sub = jax.random.split(rng)
+            params[f"w{i}"] = jax.random.normal(sub, (self.hidden_dim, self.hidden_dim)) * 0.1
+        return params
+
+    def apply(self, params, batch, rngs=None, train=True):
+        x, y = batch
+        h = x
+        for i in range(self.nlayers):
+            h = h @ params[f"w{i}"]
+            if i < self.nlayers - 1:
+                h = jnp.tanh(h)
+        return jnp.mean((h - y) ** 2)
+
+
+def random_dataloader(model_dim: int = 16, total_samples: int = 64, batch_size: int = 8, seed: int = 0):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(total_samples, model_dim).astype(np.float32)
+    y = rs.randn(total_samples, model_dim).astype(np.float32)
+    for i in range(0, total_samples, batch_size):
+        yield (x[i : i + batch_size], y[i : i + batch_size])
+
+
+def sequence_dataloader(vocab: int = 128, seq: int = 32, total: int = 32, batch: int = 8, seed: int = 0):
+    rs = np.random.RandomState(seed)
+    toks = rs.randint(0, vocab, (total, seq + 1)).astype(np.int32)
+    for i in range(0, total, batch):
+        chunk = toks[i : i + batch]
+        yield {"input_ids": chunk[:, :-1], "labels": chunk[:, 1:]}
