@@ -213,10 +213,10 @@ class TransformerLM(DSModule):
         scale = 1.0 / np.sqrt(D)
         if (
             cfg.flash_attention
-            and not train  # fwd-only for now; custom-VJP train path lands with the kernel
             and _flash_attention_available()
             and cfg.position != "alibi"
             and cfg.causal
+            and (not train or cfg.attn_dropout == 0)  # no dropout inside the fused kernel
         ):
             from deepspeed_tpu.ops.transformer.flash_attention import flash_attention
 

@@ -105,17 +105,15 @@ class _FunctionalAdapter(DSModule):
     def apply(self, params, batch, *, rngs=None, train: bool = True):
         if self.loss_fn is not None and isinstance(batch, (tuple, list)) and len(batch) == 2:
             inputs, labels = batch
-            out = (
-                self._apply(params, inputs, rngs=rngs, train=train)
-                if self._apply_kwargs
-                else self._apply(params, inputs)
-            )
-            return self.loss_fn(out, labels)
-        if self._apply_kwargs:
-            return self._apply(params, batch, rngs=rngs, train=train)
-        out = self._apply(params, batch)
+        else:
+            inputs, labels = batch, batch  # loss_fn sees the whole batch as labels
+        out = (
+            self._apply(params, inputs, rngs=rngs, train=train)
+            if self._apply_kwargs
+            else self._apply(params, inputs)
+        )
         if self.loss_fn is not None:
-            return self.loss_fn(out, batch)
+            return self.loss_fn(out, labels)
         return out
 
     def tp_partition_rules(self, params_shapes=None):

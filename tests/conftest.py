@@ -1,10 +1,16 @@
-"""Test harness: force an 8-device virtual CPU mesh BEFORE jax initializes.
+"""Test harness: force an 8-device virtual CPU mesh.
 
 Mirrors the reference's distributed-in-one-box strategy (tests/unit/common.py
 ``DistributedTest``): multi-chip semantics are exercised on one host. Here a
 single process drives 8 XLA cpu devices through the same GSPMD code paths the
-TPU pod uses (the sitecustomize force-registers the tunneled TPU backend
-unless PALLAS_AXON_POOL_IPS is empty, hence the env dance).
+TPU pod uses.
+
+The site customization (PYTHONPATH=/root/.axon_site) imports jax and
+registers the tunneled TPU backend at interpreter startup — before this file
+runs — so env vars alone are too late. We force the platform through
+jax.config (effective until the first backend use, which pytest hasn't done
+yet) and XLA_FLAGS for the cpu client's device count (the cpu client is
+created lazily, so this is still in time).
 """
 
 import os
@@ -17,7 +23,17 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
+
+
+def pytest_sessionstart(session):  # noqa: ARG001
+    devs = jax.devices()
+    assert devs[0].platform == "cpu", (
+        f"test suite must run on the virtual CPU mesh, got {devs[0].platform}; "
+        "the axon backend was initialized before conftest could force cpu"
+    )
 
 
 @pytest.fixture(autouse=True)

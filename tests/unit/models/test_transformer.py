@@ -41,7 +41,7 @@ def test_scan_matches_unrolled():
     params = m1.init(jax.random.PRNGKey(0), batch)
     l1 = m1.apply(params, batch, train=False)
     l2 = m2.apply(params, batch, train=False)
-    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-3)  # bf16 activations
 
 
 def test_remat_matches_no_remat():
