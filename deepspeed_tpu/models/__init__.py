@@ -1,2 +1,7 @@
 from deepspeed_tpu.models.config import TransformerConfig, bert_config, gpt2_config, llama_config
+from deepspeed_tpu.models.moe_transformer import (
+    MoETransformerConfig,
+    MoETransformerLM,
+    moe_llama_config,
+)
 from deepspeed_tpu.models.transformer import TransformerLM, cross_entropy_loss

@@ -1,0 +1,184 @@
+"""MoE decoder model family.
+
+Counterpart of the reference's MoE model usage (``deepspeed/moe/layer.py``
+``MoE`` wrapping each FFN; tests/unit/simple_model.py ``SimpleMoEModel``/
+``SimplePRMoEModel``): a ``TransformerLM`` whose MLP blocks are Mixture-of-
+Experts layers dispatched over the ``expert`` mesh axis.
+
+TPU-shaping: when every layer is MoE (``moe_layer_freq == 1``) the expert
+weights stack as ``[L, E, ...]`` and the block still runs under ``lax.scan``;
+with interleaved dense/MoE layers the loop unrolls (two param stacks).
+The load-balance aux loss is scaled by ``moe_aux_loss_coef`` at the layer and
+accumulated through the scan carry into the training loss.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.models.config import TransformerConfig
+from deepspeed_tpu.models.transformer import TransformerLM
+from deepspeed_tpu.moe.layer import MoE
+
+
+@dataclasses.dataclass
+class MoETransformerConfig(TransformerConfig):
+    num_experts: int = 8
+    moe_layer_freq: int = 1  # every k-th layer is MoE (reference "ep_interval")
+    moe_top_k: int = 1
+    capacity_factor: float = 1.25
+    eval_capacity_factor: float = 2.0
+    min_capacity: int = 4
+    use_residual: bool = False  # PR-MoE
+    noisy_gate_policy: Optional[str] = None  # None | 'RSample' | 'Jitter'
+    moe_drop_tokens: bool = True
+    moe_use_rts: bool = True
+    moe_aux_loss_coef: float = 0.01
+    expert_intermediate_size: Optional[int] = None
+
+    def __post_init__(self):
+        super().__post_init__()
+        if self.expert_intermediate_size is None:
+            self.expert_intermediate_size = self.intermediate_size
+        if self.moe_layer_freq > 1:
+            # mixed dense/MoE stacks can't share one scanned param stack
+            self.scan_layers = False
+
+
+class MoETransformerLM(TransformerLM):
+    def __init__(self, config: MoETransformerConfig):
+        super().__init__(config)
+        cfg = config
+        self.moe = MoE(
+            hidden_size=cfg.hidden_size,
+            num_experts=cfg.num_experts,
+            k=cfg.moe_top_k,
+            capacity_factor=cfg.capacity_factor,
+            eval_capacity_factor=cfg.eval_capacity_factor,
+            min_capacity=cfg.min_capacity,
+            use_residual=cfg.use_residual,
+            noisy_gate_policy=cfg.noisy_gate_policy,
+            drop_tokens=cfg.moe_drop_tokens,
+            use_rts=cfg.moe_use_rts,
+            intermediate_size=cfg.expert_intermediate_size,
+            activation=cfg.activation if cfg.activation in ("gelu", "relu", "swiglu", "geglu") else "gelu",
+            use_bias=cfg.use_bias,
+            out_std=0.02 / np.sqrt(2 * cfg.num_layers),
+        )
+        moe_layers = [i for i in range(cfg.num_layers) if self._is_moe_layer(i)]
+        dense_layers = [i for i in range(cfg.num_layers) if not self._is_moe_layer(i)]
+        self._moe_index = {li: j for j, li in enumerate(moe_layers)}
+        self._dense_index = {li: j for j, li in enumerate(dense_layers)}
+
+    def _is_moe_layer(self, i: int) -> bool:
+        return (i + 1) % self.config.moe_layer_freq == 0
+
+    # --- params ---------------------------------------------------------
+    def init(self, rng, batch) -> Dict[str, Any]:
+        cfg = self.config
+        rng, moe_rng = jax.random.split(rng)
+        params = super().init(rng, batch)
+        L = cfg.num_layers
+        moe_layers = [i for i in range(L) if self._is_moe_layer(i)]
+        dense_mlp_keys = {"w_in", "b_in", "w_gate", "w_up", "w_out", "b_out"}
+        present = dense_mlp_keys & set(params["layers"])
+        if cfg.moe_layer_freq == 1:
+            # every layer is MoE: drop the dense FFN stack, scan over [L, E, ...]
+            for key in present:
+                del params["layers"][key]
+            keys = jax.random.split(moe_rng, L)
+            params["layers"]["moe"] = jax.vmap(self.moe.init)(keys)
+        else:
+            # interleaved: dense FFN weights restack over dense layers only
+            # ([L_dense, ...]) so MoE layers carry no dead dense params
+            dense_idx = np.asarray([i for i in range(L) if i not in set(moe_layers)])
+            params["dense_mlp"] = {k: params["layers"].pop(k)[dense_idx] for k in present}
+            keys = jax.random.split(moe_rng, len(moe_layers))
+            params["moe_layers"] = jax.vmap(self.moe.init)(keys)
+        return params
+
+    def _layer_params(self, params, i: int):
+        """Unrolled path (moe_layer_freq > 1): merge the layer's attention
+        stack slice with its dense-FFN or MoE params by layer index."""
+        per_layer = jax.tree_util.tree_map(lambda a: a[i], params["layers"])
+        if self.config.moe_layer_freq == 1:
+            return per_layer
+        if self._is_moe_layer(i):
+            j = self._moe_index[i]
+            per_layer["moe"] = jax.tree_util.tree_map(lambda a: a[j], params["moe_layers"])
+        else:
+            j = self._dense_index[i]
+            for k, v in params["dense_mlp"].items():
+                per_layer[k] = v[j]
+        return per_layer
+
+    # --- sharding -------------------------------------------------------
+    def tp_partition_rules(self, params_shapes=None) -> Any:
+        if params_shapes is None:
+            return None
+        base = super().tp_partition_rules(params_shapes)
+
+        def moe_rules(stacked_moe_shapes):
+            """Stacked [L?, E, ...] expert leaves → expert-axis specs."""
+
+            def walk(prefix, tree):
+                if isinstance(tree, dict):
+                    return {k: walk(f"{prefix}/{k}", v) for k, v in tree.items()}
+                nd = len(tree.shape)
+                if prefix.startswith("/experts"):
+                    # leading stack dim (scanned layer), then the expert dim
+                    return P(None, "expert", *([None] * (nd - 2)))
+                return P(*([None] * nd))
+
+            return walk("", stacked_moe_shapes)
+
+        if "moe" in params_shapes.get("layers", {}):
+            base["layers"]["moe"] = moe_rules(params_shapes["layers"]["moe"])
+        if "moe_layers" in params_shapes:
+            base["moe_layers"] = moe_rules(params_shapes["moe_layers"])
+        # dense_mlp (interleaved mode) already gets correct Megatron col/row
+        # specs from the base name-driven walk — nothing to override.
+        return base
+
+    def keep_fp32_params(self, params_shapes=None) -> Any:
+        """Router (gate) weights stay fp32 under mixed precision — the
+        reference's TopKGate holds ``wg`` in fp32 for routing stability."""
+        if params_shapes is None:
+            return None
+
+        def walk(prefix, tree):
+            if isinstance(tree, dict):
+                return {k: walk(f"{prefix}/{k}", v) for k, v in tree.items()}
+            return prefix.endswith("/gate/wg")
+
+        return walk("", params_shapes)
+
+    # --- forward --------------------------------------------------------
+    def _mlp(self, p, h, rng, train):
+        cfg = self.config
+        if "moe" in p:
+            out, l_aux, _counts = self.moe.apply(p["moe"], h, train=train, rng=rng)
+            return out, l_aux * jnp.float32(cfg.moe_aux_loss_coef)
+        return super()._mlp(p, h, rng, train)
+
+def moe_llama_config(size: str = "tiny", **overrides) -> MoETransformerConfig:
+    presets = {
+        "tiny": dict(hidden_size=256, num_layers=4, num_heads=8, vocab_size=32000, max_seq_len=512),
+        "1b-8e": dict(hidden_size=2048, num_layers=22, num_heads=32, num_kv_heads=4, vocab_size=32000),
+    }
+    base = dict(
+        norm="rmsnorm",
+        position="rope",
+        activation="swiglu",
+        use_bias=False,
+        tie_embeddings=False,
+    )
+    base.update(presets[size])
+    base.update(overrides)
+    return MoETransformerConfig(**base)

@@ -236,6 +236,17 @@ class TransformerLM(DSModule):
         probs = probs.astype(v.dtype)
         return jnp.einsum("bnts,bsnd->btnd", probs, v)
 
+    def _mlp(self, p, h, rng, train):
+        """Dense FFN; MoE model families override this (returns (out, aux_loss))."""
+        from deepspeed_tpu.moe.experts import apply_dense_ffn
+
+        return apply_dense_ffn(p, h, self.config.activation), jnp.zeros((), jnp.float32)
+
+    def _layer_params(self, params, i: int):
+        """Per-layer param tree for the unrolled (non-scan) path; model
+        families with heterogeneous layers (MoE interleave) override this."""
+        return jax.tree_util.tree_map(lambda a: a[i], params["layers"])
+
     def _layer(self, carry_x, layer_params, positions, rng, train):
         cfg = self.config
         p = layer_params
@@ -255,7 +266,7 @@ class TransformerLM(DSModule):
         if cfg.position == "rope":
             q = _rope(q, positions, cfg.rope_theta)
             k = _rope(k, positions, cfg.rope_theta)
-        rng, r_attn, r_hid = jax.random.split(rng, 3) if rng is not None else (None, None, None)
+        rng, r_attn, r_hid, r_mlp = jax.random.split(rng, 4) if rng is not None else (None, None, None, None)
         attn = self._attention(q, k, v, positions, r_attn, train)
         attn = attn.reshape(B, T, NH * D) @ p["wo"].astype(h.dtype)
         if cfg.use_bias:
@@ -266,20 +277,8 @@ class TransformerLM(DSModule):
         x = x + attn
 
         h = _norm(x, p["mlp_norm_scale"], p.get("mlp_norm_bias"), cfg.norm, cfg.norm_eps)
-        if cfg.activation in ("swiglu", "geglu"):
-            gate = h @ p["w_gate"].astype(h.dtype)
-            up = h @ p["w_up"].astype(h.dtype)
-            act = jax.nn.silu(gate) if cfg.activation == "swiglu" else jax.nn.gelu(gate)
-            inner = act * up
-        else:
-            inner = h @ p["w_in"].astype(h.dtype)
-            if cfg.use_bias:
-                inner = inner + p["b_in"].astype(h.dtype)
-            inner = jax.nn.gelu(inner) if cfg.activation == "gelu" else jax.nn.relu(inner)
-        out = inner @ p["w_out"].astype(h.dtype)
-        if cfg.use_bias:
-            out = out + p["b_out"].astype(h.dtype)
-        return x + out
+        out, aux = self._mlp(p, h, r_mlp, train)
+        return x + out, aux
 
     def _forward(self, params, tokens, rngs, train):
         cfg = self.config
@@ -299,33 +298,41 @@ class TransformerLM(DSModule):
                 rng, sub = jax.random.split(rng)
             else:
                 sub = None
-            x = self._layer(x, per_layer, positions, sub, train)
-            return (x, rng), None
+            x, aux = self._layer(x, per_layer, positions, sub, train)
+            return (x, rng), aux
 
         if cfg.remat:
             policy = getattr(jax.checkpoint_policies, cfg.remat_policy, None)
             body = jax.checkpoint(body, policy=policy, prevent_cse=False)
 
+        aux_total = jnp.zeros((), jnp.float32)
         if cfg.scan_layers:
-            (x, _), _ = jax.lax.scan(body, (x, base_rng), params["layers"])
+            (x, _), aux_per_layer = jax.lax.scan(body, (x, base_rng), params["layers"])
+            aux_total = jnp.sum(aux_per_layer)
         else:
             for i in range(L):
-                per_layer = jax.tree_util.tree_map(lambda a: a[i], params["layers"])
-                (x, base_rng), _ = body((x, base_rng), per_layer)
+                (x, base_rng), aux = body((x, base_rng), self._layer_params(params, i))
+                aux_total = aux_total + aux
 
         x = _norm(x, params["final_norm_scale"], params.get("final_norm_bias"), cfg.norm, cfg.norm_eps)
         if cfg.tie_embeddings:
             logits = x @ params["embed"]["tokens"].astype(self.dtype).T
         else:
             logits = x @ params["lm_head"].astype(self.dtype)
-        return logits
+        return logits, aux_total
 
     def apply(self, params, batch, *, rngs=None, train: bool = True):
         tokens, labels = _split_batch(batch)
-        logits = self._forward(params, tokens, rngs, train)
+        logits, aux = self._forward(params, tokens, rngs, train)
         if labels is None:
             return logits
-        return cross_entropy_loss(logits, labels)
+        loss = cross_entropy_loss(logits, labels)
+        if train:
+            # aux is the (already coefficient-scaled) MoE load-balance loss;
+            # zero for dense families. Train-only, so eval loss stays pure CE
+            # (the reference adds l_aux only in training client code).
+            loss = loss + aux
+        return loss
 
 
 def _split_batch(batch):

@@ -1,0 +1,175 @@
+"""MoE layer: gate + sharded experts (+ PR-MoE residual).
+
+Counterpart of ``deepspeed/moe/layer.py`` (``MoE`` :16) and the ``MOELayer``
+/ ``TopKGate`` pair (``deepspeed/moe/sharded_moe.py:435,:370``). The
+reference binds experts to an expert-parallel process group created lazily in
+``set_deepspeed_parallelism`` (layer.py:87); here expert placement is the
+``expert`` mesh axis: the stacked ``[E, ...]`` expert weights and the
+dispatched ``[E, C, H]`` activations both carry an ``expert``-axis sharding
+constraint, and GSPMD materializes the reference's ``_AllToAll`` exchange
+(sharded_moe.py:98) as XLA all-to-alls over ICI.
+
+``use_residual=True`` gives PR-MoE (pyramid-residual, layer.py use_residual
+branch): a dense MLP runs in parallel and a learned 2-way coefficient mixes
+both outputs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.moe import sharded_moe
+from deepspeed_tpu.moe.experts import (
+    apply_dense_ffn,
+    apply_expert_ffn,
+    expert_partition_rules,
+    init_dense_ffn,
+    init_expert_ffn,
+)
+
+
+class MoE:
+    """Mixture of Experts layer (functional).
+
+    ``init(rng)`` builds the param tree; ``apply(params, x, ...)`` returns
+    ``(output, l_aux, exp_counts)`` exactly like the reference's
+    ``MoE.forward`` (layer.py:115).
+    """
+
+    def __init__(
+        self,
+        hidden_size: int,
+        num_experts: int = 1,
+        ep_size: int = 1,
+        k: int = 1,
+        capacity_factor: float = 1.0,
+        eval_capacity_factor: float = 1.0,
+        min_capacity: int = 4,
+        use_residual: bool = False,
+        noisy_gate_policy: Optional[str] = None,
+        drop_tokens: bool = True,
+        use_rts: bool = True,
+        intermediate_size: Optional[int] = None,
+        activation: str = "gelu",
+        use_bias: bool = True,
+        out_std: Optional[float] = None,
+    ):
+        self.hidden_size = hidden_size
+        self.num_experts = num_experts
+        # ep_size is accepted for reference-API parity (layer.py:16) but expert
+        # placement is mesh-driven here: the 'expert' axis of the device mesh
+        # (config "mesh": {"expert": N}) decides the parallel degree.
+        self.ep_size = ep_size
+        self.k = k
+        self.capacity_factor = capacity_factor
+        self.eval_capacity_factor = eval_capacity_factor
+        self.min_capacity = min_capacity
+        self.use_residual = use_residual
+        self.noisy_gate_policy = noisy_gate_policy
+        self.drop_tokens = drop_tokens
+        self.use_rts = use_rts
+        self.intermediate_size = intermediate_size or 4 * hidden_size
+        self.activation = activation
+        self.use_bias = use_bias
+        self.out_std = out_std
+
+    # --- params ---------------------------------------------------------
+    def init(self, rng) -> Dict[str, Any]:
+        kg, ke, km, kc = jax.random.split(rng, 4)
+        params: Dict[str, Any] = {
+            # gate weight is fp32 always (reference TopKGate keeps wg in fp32)
+            "gate": {"wg": jax.random.normal(kg, (self.hidden_size, self.num_experts), jnp.float32) * 0.02},
+            "experts": init_expert_ffn(
+                ke,
+                self.num_experts,
+                self.hidden_size,
+                self.intermediate_size,
+                activation=self.activation,
+                use_bias=self.use_bias,
+                out_std=self.out_std,
+            ),
+        }
+        if self.use_residual:
+            H = self.hidden_size
+            params["mlp"] = init_dense_ffn(
+                km,
+                H,
+                self.intermediate_size,
+                activation=self.activation,
+                use_bias=self.use_bias,
+                out_std=self.out_std,
+            )
+            params["coefficient"] = {
+                "w": jax.random.normal(kc, (H, 2), jnp.float32) * 0.02,
+                "b": jnp.zeros((2,)),
+            }
+        return params
+
+    # --- sharding -------------------------------------------------------
+    def partition_rules(self, params: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        """Expert weights over the ``expert`` axis; gate/residual replicated."""
+        if params is None:
+            params = jax.eval_shape(lambda r: self.init(r), jax.random.PRNGKey(0))
+        rules = jax.tree_util.tree_map(lambda p: P(*([None] * np.ndim(p))), params)
+        rules["experts"] = expert_partition_rules(params["experts"])
+        return rules
+
+    def _constrain(self, x, spec):
+        """Sharding constraint against the active topology (no-op off-mesh)."""
+        from deepspeed_tpu.parallel.mesh import _TOPOLOGY
+
+        if _TOPOLOGY is None or _TOPOLOGY.config.expert <= 1:
+            return x
+        return jax.lax.with_sharding_constraint(x, NamedSharding(_TOPOLOGY.mesh, spec))
+
+    # --- forward --------------------------------------------------------
+    def apply(
+        self,
+        params: Dict[str, Any],
+        x: jnp.ndarray,
+        *,
+        train: bool = True,
+        rng: Optional[jax.Array] = None,
+        used_token_mask: Optional[jnp.ndarray] = None,
+    ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        orig_shape = x.shape
+        H = orig_shape[-1]
+        tokens = x.reshape(-1, H)
+
+        gate_in = tokens
+        if self.noisy_gate_policy == "Jitter" and train and rng is not None:
+            rng, sub = jax.random.split(rng)
+            gate_in = sharded_moe.multiplicative_jitter(tokens, sub)
+        logits = gate_in.astype(jnp.float32) @ params["gate"]["wg"]
+
+        cf = self.capacity_factor if train else self.eval_capacity_factor
+        l_aux, combine_w, dispatch_m, exp_counts = sharded_moe.topkgating(
+            logits,
+            self.k,
+            cf,
+            self.min_capacity,
+            drop_tokens=self.drop_tokens,
+            rng=rng if train else None,
+            noisy_gate_policy=self.noisy_gate_policy if train else None,
+            use_rts=self.use_rts,
+            used_token_mask=used_token_mask,
+        )
+
+        dispatched = sharded_moe.dispatch(tokens, dispatch_m)
+        dispatched = self._constrain(dispatched, P("expert", None, None))
+        expert_out = apply_expert_ffn(params["experts"], dispatched, self.activation)
+        expert_out = self._constrain(expert_out, P("expert", None, None))
+        out = sharded_moe.combine(expert_out, combine_w)
+
+        if self.use_residual:
+            mlp_out = apply_dense_ffn(params["mlp"], tokens, self.activation)
+            coef = tokens.astype(jnp.float32) @ params["coefficient"]["w"] + params["coefficient"]["b"]
+            coef = jax.nn.softmax(coef, axis=-1).astype(out.dtype)
+            out = out * coef[..., 0:1] + mlp_out * coef[..., 1:2]
+
+        return out.reshape(orig_shape), l_aux, exp_counts

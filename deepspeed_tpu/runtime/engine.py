@@ -324,11 +324,15 @@ class DeepSpeedEngine:
             master = jax.jit(_sharded_init, out_shardings=master_shardings)(self._rng, placed)
 
         if self.mixed_precision:
-            cast = jax.jit(
-                lambda t: jax.tree_util.tree_map(lambda x: x.astype(self.compute_dtype), t),
-                out_shardings=param_shardings,
-            )
-            self._params = cast(master)
+            keep32 = self.module.keep_fp32_params(param_shapes) if hasattr(self.module, "keep_fp32_params") else None
+            self._keep_fp32 = keep32
+            if keep32 is None:
+                cast_tree = lambda t: jax.tree_util.tree_map(lambda x: x.astype(self.compute_dtype), t)
+            else:
+                cast_tree = lambda t: jax.tree_util.tree_map(
+                    lambda x, keep: x if keep else x.astype(self.compute_dtype), t, keep32
+                )
+            self._params = jax.jit(cast_tree, out_shardings=param_shardings)(master)
             self._master = master
         else:
             # fp32 training: one copy, stored with the (possibly ZeRO-3) param
@@ -459,8 +463,10 @@ class DeepSpeedEngine:
                 lambda n, o: jnp.where(overflow, o, n), new_opt, opt_state
             )
             if mixed:
+                # re-cast to each param's stored dtype (keep_fp32_params leaves
+                # stay fp32; everything else is the compute dtype)
                 new_params = jax.tree_util.tree_map(
-                    lambda m, p: jnp.where(overflow, p, m.astype(compute_dtype)), new_master, params
+                    lambda m, p: jnp.where(overflow, p, m.astype(p.dtype)), new_master, params
                 )
             else:
                 new_params = new_master

@@ -33,6 +33,12 @@ class DSModule:
         """Optional pytree of PartitionSpec carrying tensor/model-parallel axes."""
         return None
 
+    def keep_fp32_params(self, params_shapes=None) -> Optional[Any]:
+        """Optional pytree of bools marking params that must stay fp32 in the
+        compute store under mixed precision (e.g. MoE router weights — the
+        reference's TopKGate keeps ``wg`` fp32 for routing stability)."""
+        return None
+
 
 class _FlaxAdapter(DSModule):
     def __init__(self, module, loss_fn: Optional[Callable] = None):
