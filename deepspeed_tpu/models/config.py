@@ -41,7 +41,8 @@ class TransformerConfig:
     remat_policy: str = "nothing_saveable"
     scan_layers: bool = True  # lax.scan over stacked layer params
     flash_attention: bool = True  # use the Pallas fused-attention kernel when available (falls back to einsum)
-    sequence_parallel: bool = False  # Ulysses all-to-all attention over the 'sequence' axis
+    sequence_parallel: bool = False  # sequence parallelism over the 'sequence' axis
+    sequence_parallel_mode: str = "ulysses"  # ulysses (all-to-all) | ring (ppermute)
 
     def __post_init__(self):
         if self.head_dim is None:
@@ -56,6 +57,11 @@ class TransformerConfig:
                 self.intermediate_size = 4 * self.hidden_size
         if self.qkv_bias is None:
             self.qkv_bias = self.use_bias
+        if self.sequence_parallel_mode not in ("ulysses", "ring"):
+            raise ValueError(
+                f"unknown sequence_parallel_mode {self.sequence_parallel_mode!r}; "
+                "expected 'ulysses' or 'ring'"
+            )
 
 
 def gpt2_config(size: str = "125m", **overrides) -> TransformerConfig:

@@ -102,11 +102,6 @@ class TransformerLM(DSModule):
     """
 
     def __init__(self, config: TransformerConfig):
-        if config.sequence_parallel:
-            raise NotImplementedError(
-                "sequence_parallel: the Ulysses all-to-all attention wrapper is not yet "
-                "wired into TransformerLM (deepspeed_tpu.sequence); unset the flag"
-            )
         self.config = config
         self.dtype = _DTYPES[config.dtype]
 
@@ -203,14 +198,27 @@ class TransformerLM(DSModule):
 
     # --- forward ---------------------------------------------------------
     def _attention(self, q, k, v, positions, dropout_rng, train):
-        """[B, T, N, D] → [B, T, N, D]; causal, GQA-aware."""
+        """[B, T, NH, D] q / [B, T, NKV, D] k,v → [B, T, NH, D].
+
+        Dispatches to sequence-parallel paths BEFORE expanding GQA kv heads
+        so ring's ppermute and (when divisible) Ulysses' all-to-all move only
+        the NKV-head kv bytes.
+        """
         cfg = self.config
-        B, T, NH, D = q.shape
-        NKV = k.shape[2]
-        if NKV != NH:
-            k = jnp.repeat(k, NH // NKV, axis=2)
-            v = jnp.repeat(v, NH // NKV, axis=2)
-        scale = 1.0 / np.sqrt(D)
+        scale = 1.0 / np.sqrt(q.shape[-1])
+        if cfg.sequence_parallel:
+            sp_out = self._sp_attention(q, k, v, positions, dropout_rng, train, scale)
+            if sp_out is not None:
+                return sp_out
+        k, v = _expand_gqa(q, k, v)
+        return self._local_full_attention(q, k, v, positions, scale, dropout_rng, train)
+
+    def _local_full_attention(self, q, k, v, positions, scale, dropout_rng=None, train=False):
+        """Full-sequence attention on (possibly head-sharded) q/k/v with
+        equal head counts: the single implementation used by the local path
+        and as the Ulysses local op."""
+        cfg = self.config
+        NH = q.shape[2]
         if (
             cfg.flash_attention
             and _flash_attention_available()
@@ -235,6 +243,66 @@ class TransformerLM(DSModule):
             probs = probs * keep / (1 - cfg.attn_dropout)
         probs = probs.astype(v.dtype)
         return jnp.einsum("bnts,bsnd->btnd", probs, v)
+
+    def _sp_attention(self, q, k, v, positions, dropout_rng, train, scale):
+        """Sequence-parallel attention (Ulysses all-to-all or ring ppermute).
+
+        Returns None when the mesh has no sequence axis (caller falls through
+        to the local path). Reference: deepspeed/sequence/layer.py (Ulysses);
+        ring is the TPU-native long-context extension (sequence/ring.py).
+        Both SP paths assume contiguous 0..T-1 positions (what ``_forward``
+        produces); packed/offset position ids are not supported under SP.
+        """
+        cfg = self.config
+        if cfg.sequence_parallel_mode not in ("ulysses", "ring"):
+            raise ValueError(
+                f"unknown sequence_parallel_mode {cfg.sequence_parallel_mode!r}; "
+                "expected 'ulysses' or 'ring'"
+            )
+        from deepspeed_tpu.parallel.mesh import get_topology
+
+        topo = get_topology()
+        sp = topo.axis_size("sequence")
+        if sp == 1:
+            return None
+        if cfg.position == "alibi":
+            raise NotImplementedError("sequence_parallel with alibi positions is unsupported")
+        if train and cfg.attn_dropout > 0:
+            raise NotImplementedError("sequence_parallel with attention dropout is unsupported")
+        batch_axes = topo.dense_batch_axes()
+        head_axes = "model" if topo.axis_size("model") > 1 else None
+
+        if cfg.sequence_parallel_mode == "ring":
+            from deepspeed_tpu.sequence.ring import ring_attention
+
+            return ring_attention(
+                q, k, v,
+                mesh=topo.mesh,
+                causal=cfg.causal,
+                scale=scale,
+                batch_axes=batch_axes,
+                head_axes=head_axes,
+            )
+
+        from deepspeed_tpu.sequence.layer import DistributedAttention
+
+        # Ulysses scatters the head dim over the sequence axis; kv can ride
+        # the all-to-all at NKV heads iff sp divides NKV — otherwise they
+        # must be pre-expanded to NH (layer.py:37's head-count constraint).
+        NKV = k.shape[2]
+        expand_late = NKV != q.shape[2] and NKV % sp == 0
+
+        def local_attn(q_, k_, v_):
+            if expand_late:
+                k_, v_ = _expand_gqa(q_, k_, v_)
+            return self._local_full_attention(q_, k_, v_, positions, scale)
+
+        dist_attn = DistributedAttention(
+            local_attn, topo.mesh, batch_axes=batch_axes, head_axes=head_axes
+        )
+        if not expand_late:
+            k, v = _expand_gqa(q, k, v)
+        return dist_attn(q, k, v)
 
     def _mlp(self, p, h, rng, train):
         """Dense FFN; MoE model families override this (returns (out, aux_loss))."""
@@ -333,6 +401,15 @@ class TransformerLM(DSModule):
             # (the reference adds l_aux only in training client code).
             loss = loss + aux
         return loss
+
+
+def _expand_gqa(q, k, v):
+    """Repeat kv heads up to q's head count (no-op for MHA)."""
+    NH, NKV = q.shape[2], k.shape[2]
+    if NKV != NH:
+        k = jnp.repeat(k, NH // NKV, axis=2)
+        v = jnp.repeat(v, NH // NKV, axis=2)
+    return k, v
 
 
 def _split_batch(batch):

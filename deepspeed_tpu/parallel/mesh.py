@@ -104,6 +104,17 @@ class Topology:
     def axis_size(self, name: str) -> int:
         return dict(zip(self.mesh.axis_names, self.mesh.devices.shape))[name]
 
+    def dense_batch_axes(self):
+        """Mesh axes the batch's leading dim is sharded over, normalized to
+        None | str | tuple — the single source for batch PartitionSpec entries
+        (used by the engine's batch placement and the SP attention specs)."""
+        axes = tuple(a for a in ("data", "expert") if self.axis_size(a) > 1)
+        if not axes:
+            return None
+        if len(axes) == 1:
+            return axes[0]
+        return axes
+
 
 def build_mesh(
     mesh_config: MeshConfig,

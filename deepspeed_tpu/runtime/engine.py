@@ -363,14 +363,14 @@ class DeepSpeedEngine:
     def _batch_pspec(self, batch) -> Any:
         """Batch sharding: leading dim over the dense-DP axes, dim 1 (sequence)
         over the sequence axis when SP is on."""
-        dp_axes = tuple(a for a in ("data", "expert") if self.topology.axis_size(a) > 1) or None
+        dp_axes = self.topology.dense_batch_axes()
         seq = self.topology.config.sequence > 1
 
         def leaf_spec(x):
             nd = np.ndim(x)
             if nd == 0:
                 return PartitionSpec()
-            entries = [dp_axes if isinstance(dp_axes, tuple) and len(dp_axes) > 1 else (dp_axes[0] if dp_axes else None)]
+            entries = [dp_axes]
             if nd >= 2 and seq:
                 entries.append("sequence")
             entries += [None] * (nd - len(entries))

@@ -1,0 +1,11 @@
+"""Sequence parallelism (DeepSpeed-Ulysses) + ring-attention extension.
+
+Reference: ``deepspeed/sequence/`` (layer.py — DistributedAttention,
+_SeqAllToAll). Ring attention has no reference counterpart (SURVEY §2.3) and
+is provided as the TPU-native long-context extension.
+"""
+
+from deepspeed_tpu.sequence.layer import DistributedAttention, UlyssesAttention, seq_all_to_all
+from deepspeed_tpu.sequence.ring import ring_attention
+
+__all__ = ["DistributedAttention", "UlyssesAttention", "seq_all_to_all", "ring_attention"]

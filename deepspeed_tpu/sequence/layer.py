@@ -1,0 +1,127 @@
+"""DeepSpeed-Ulysses sequence parallelism, TPU-native.
+
+Reference: ``deepspeed/sequence/layer.py`` — ``_SeqAllToAll`` (:15) swaps a
+sequence-sharded activation to a head-sharded one with a single all-to-all
+over the sequence process group, ``DistributedAttention`` (:37) wraps any
+local attention with that swap before and its inverse after (:61-85).
+
+On TPU the same dataflow is expressed two ways, both provided here:
+
+* **GSPMD flavor** (`DistributedAttention`, used inside ``jit``): the swap is
+  a ``with_sharding_constraint`` from ``P(..., 'sequence', heads, ...)`` to
+  ``P(..., None, ('sequence', heads...), ...)``; XLA lowers the resharding to
+  exactly one all-to-all over the ICI ring, and fuses it with neighboring
+  ops. No manual communication code, and the collective overlaps with
+  compute wherever XLA's scheduler finds room.
+
+* **shard_map flavor** (`seq_all_to_all`): explicit ``lax.all_to_all`` with
+  the reference's (scatter_idx, gather_idx) signature, for code already
+  inside a ``shard_map`` region (e.g. the pipeline engine's stages).
+
+Composition with ZeRO mirrors the reference: the engine's batch spec shards
+tokens over the ``sequence`` axis and gradients reduce over seq×data
+(``parallel/mesh.py`` ``data_parallel_axes``), matching engine.py:1111's
+seq_data group.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def seq_all_to_all(x, scatter_idx: int, gather_idx: int, axis_name: str = "sequence"):
+    """Explicit all-to-all for shard_map regions (reference ``_SeqAllToAll``,
+    deepspeed/sequence/layer.py:15).
+
+    Scatters local dim ``scatter_idx`` across the axis and gathers the
+    (sharded) dim ``gather_idx``: [.., S, .., h/p, ..] ↔ [.., s/p, .., H, ..].
+    Differentiable — the transpose of an all-to-all is the inverse
+    all-to-all, which JAX derives automatically.
+    """
+    return jax.lax.all_to_all(x, axis_name, split_axis=scatter_idx, concat_axis=gather_idx, tiled=True)
+
+
+def _spec_with(entries) -> P:
+    return P(*entries)
+
+
+class DistributedAttention:
+    """Ulysses wrapper around any local attention (GSPMD flavor).
+
+    ``local_attn(q, k, v, *args, **kwargs) -> out`` operates on
+    ``[B, T, N, D]`` arrays that carry the full sequence but a head shard;
+    this wrapper accepts arrays logically sharded ``[B, T/sp, N, D]`` and
+    performs the two all-to-alls via resharding constraints.
+
+    Reference: ``DistributedAttention`` deepspeed/sequence/layer.py:37
+    (scatter_idx=2 → heads, gather_idx=1 → sequence, matching the
+    [B, T, N, D] layout used throughout this framework).
+    """
+
+    def __init__(
+        self,
+        local_attn: Callable,
+        mesh=None,
+        *,
+        seq_axis: str = "sequence",
+        head_axes: Union[str, Tuple[str, ...], None] = None,
+        batch_axes: Union[str, Tuple[str, ...], None] = None,
+        scatter_idx: int = 2,
+        gather_idx: int = 1,
+    ):
+        self.local_attn = local_attn
+        self.mesh = mesh
+        self.seq_axis = seq_axis
+        self.head_axes = head_axes
+        self.batch_axes = batch_axes
+        self.scatter_idx = scatter_idx
+        self.gather_idx = gather_idx
+
+    def _mesh(self):
+        if self.mesh is not None:
+            return self.mesh
+        from deepspeed_tpu.parallel.mesh import get_topology
+
+        return get_topology().mesh
+
+    def _specs(self, ndim: int) -> Tuple[P, P]:
+        """(seq-sharded spec, head-sharded spec) for an ndim-rank array."""
+        entries_seq = [None] * ndim
+        entries_head = [None] * ndim
+        entries_seq[0] = entries_head[0] = self.batch_axes
+        entries_seq[self.gather_idx] = self.seq_axis
+        head = self.head_axes
+        if head is None:
+            combined = (self.seq_axis,)
+        elif isinstance(head, str):
+            combined = (self.seq_axis, head)
+            entries_seq[self.scatter_idx] = head
+        else:
+            combined = (self.seq_axis, *head)
+            entries_seq[self.scatter_idx] = tuple(head)
+        entries_head[self.scatter_idx] = combined
+        return _spec_with(entries_seq), _spec_with(entries_head)
+
+    def __call__(self, query, key, value, *args, **kwargs):
+        mesh = self._mesh()
+        if mesh.shape.get(self.seq_axis, 1) == 1:
+            return self.local_attn(query, key, value, *args, **kwargs)
+        seq_spec, head_spec = self._specs(query.ndim)
+
+        def cst(x, spec):
+            return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+        # seq-shard → head-shard: one all-to-all each (layer.py:61-66)
+        q = cst(cst(query, seq_spec), head_spec)
+        k = cst(cst(key, seq_spec), head_spec)
+        v = cst(cst(value, seq_spec), head_spec)
+        out = self.local_attn(q, k, v, *args, **kwargs)
+        # head-shard → seq-shard: the inverse all-to-all (layer.py:79-85)
+        return cst(cst(out, head_spec), seq_spec)
+
+
+class UlyssesAttention(DistributedAttention):
+    """Alias matching the blog/API name (blogs/deepspeed-ulysses)."""
