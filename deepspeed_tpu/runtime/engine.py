@@ -131,6 +131,12 @@ class DeepSpeedEngine:
         self.optimizer = self._configure_optimizer()
         self.lr_scheduler = self._configure_lr_scheduler()
 
+        # grad divisor at step time: normally the GAS count (each micro-step
+        # accumulated one microbatch's grads); the pipeline engine fuses all
+        # microbatches into one fwd_bwd whose loss is already the mean, so it
+        # overrides this to 1 before the jitted fns are built.
+        self._gas_divisor = self.gradient_accumulation_steps()
+
         # counters -------------------------------------------------------
         self.micro_steps = 0
         self.global_steps = 0
@@ -407,7 +413,7 @@ class DeepSpeedEngine:
         module = self.module
         grad_specs = self._grad_specs
         mesh = self.mesh
-        gas = self.gradient_accumulation_steps()
+        gas = self._gas_divisor
         clip = self._config.gradient_clipping
         fp16 = self._config.fp16_enabled
         scaler = self.loss_scaler
@@ -605,19 +611,39 @@ class DeepSpeedEngine:
         self.monitor.write_events(events)
 
     def train_batch(self, data_iter=None, batch=None):
-        """Convenience: run a full GAS cycle (gas × fwd/bwd + step)."""
+        """Convenience: run a full GAS cycle (gas × fwd/bwd + step).
+
+        ``batch``, when given, is the FULL-step batch — its leading dim is
+        sliced into ``gas`` microbatches (matching the pipeline engine's
+        contract so the same caller works at any mesh.pipe)."""
+        gas = self.gradient_accumulation_steps()
+        micro = self._split_step_batch(batch, gas) if batch is not None else None
         losses = []
-        for _ in range(self.gradient_accumulation_steps()):
-            if batch is None:
-                b = next(data_iter)
-            else:
-                b = batch
+        for g in range(gas):
+            b = micro[g] if micro is not None else next(data_iter)
             loss = self.forward(b)
             self.backward(loss)
             self.step()
             losses.append(loss)
         total = sum(jax.device_get(l) for l in losses) / len(losses)
         return total
+
+    def _split_step_batch(self, batch, gas: int):
+        """Slice a full-step batch into gas microbatches along the leading dim."""
+        if gas == 1:
+            return [batch]
+        leaves = jax.tree_util.tree_leaves(batch)
+        B = np.shape(leaves[0])[0]
+        if B % gas != 0:
+            raise ValueError(
+                f"train_batch(batch=...) leading dim {B} is not divisible by "
+                f"gradient_accumulation_steps={gas}"
+            )
+        b = B // gas
+        return [
+            jax.tree_util.tree_map(lambda l: l[g * b : (g + 1) * b], batch)
+            for g in range(gas)
+        ]
 
     # ------------------------------------------------------------------
     # checkpointing (reference: engine.py:2961 save / :2638 load)

@@ -1,61 +1,117 @@
 """Pipeline engine.
 
 Counterpart of the reference's ``PipelineEngine``
-(``deepspeed/runtime/pipe/engine.py:54``) and its instruction schedule
-(``deepspeed/runtime/pipe/schedule.py``). Round-1 scope: the engine accepts a
-``PipelineModule`` and trains it with the standard fused step — on TPU a
-1-stage pipeline (pipe mesh axis = 1) is exactly the dense engine, and the
-layer sequence runs as one XLA program. ``train_batch``/``eval_batch``
-(reference :297/:404) are provided so user loops port unchanged.
+(``deepspeed/runtime/pipe/engine.py:54``). The reference interprets an
+instruction schedule (``schedule.py``) with p2p sends between stage
+processes; here the pipe axis is a mesh dimension and the whole schedule is
+one jitted collective loop (``runtime/pipe/spmd.py``) — see that module for
+the mapping. ``train_batch``/``eval_batch`` (reference :297/:404) are the
+blessed API: one call consumes ``gradient_accumulation_steps`` microbatches
+and takes one optimizer step, exactly the reference contract. Direct
+``backward``/``step`` calls raise, mirroring the reference
+(pipe/engine.py:1290-1305 disables them).
 
-The pipe-axis>1 path (microbatch interleave via ``shard_map`` over the
-``pipe`` axis with ``ppermute`` stage handoffs — the 1F1B schedule expressed
-as a ``lax.scan`` over microbatches) is staged in
-``deepspeed_tpu/runtime/pipe/schedule.py`` and wired up when the pipe axis is
-enabled; until then a pipe axis > 1 raises rather than silently misplacing
-layers.
+With pipe axis == 1 the layer sequence runs as one fused XLA program and the
+engine behaves like the dense engine with train_batch sugar.
 """
 
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 
 from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+from deepspeed_tpu.runtime.pipe.spmd import SpmdPipelineModule
 from deepspeed_tpu.utils.logging import log_dist
 
 
 class PipelineEngine(DeepSpeedEngine):
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
-        if self.topology.get_pipe_parallel_world_size() > 1:
-            raise NotImplementedError(
-                "pipe mesh axis > 1: the scan/ppermute 1F1B schedule is not wired up yet; "
-                "run with mesh.pipe=1 (layers execute as one fused XLA program)"
-            )
+        self.num_stages = self.topology.get_pipe_parallel_world_size()
         self.micro_batches = self.gradient_accumulation_steps()
+        self._pipe_parallel = self.num_stages > 1
+        if self._pipe_parallel:
+            # all microbatches flow through ONE fwd_bwd whose loss is already
+            # the microbatch mean → no further division by gas at step time
+            self._gas_divisor = 1
+            self.module = SpmdPipelineModule(
+                self.module, self.topology, num_micro=self.micro_batches
+            )
         log_dist(
-            f"PipelineEngine: {len(self.module.layer_specs)} layers, "
-            f"{self.micro_batches} microbatches/step",
+            f"PipelineEngine: {len(self.module.inner.layer_specs) if self._pipe_parallel else len(self.module.layer_specs)} "
+            f"layers over {self.num_stages} stage(s), {self.micro_batches} microbatches/step",
             ranks=[0],
         )
 
+    # --- reference API: train_batch/eval_batch --------------------------
     def train_batch(self, data_iter=None, batch=None):
-        """Full pipeline step: gas microbatches + optimizer step
+        """One full step: gas microbatches + optimizer step
         (reference pipe/engine.py:297)."""
         self.train()
-        return super().train_batch(data_iter=data_iter, batch=batch)
+        if not self._pipe_parallel:
+            return super().train_batch(data_iter=data_iter, batch=batch)
+        combined = self._collect_batch(data_iter, batch)
+        loss = super().forward(combined)
+        self._in_forward = False
+        # one fused fwd_bwd covered all gas microbatches: advance the
+        # micro-step counter and sample count to the GAS boundary, then take
+        # the model step (step() accounts the final microbatch itself)
+        self.micro_steps += self.micro_batches - 1
+        self.global_samples += (
+            self.train_micro_batch_size_per_gpu()
+            * self.data_parallel_world_size()
+            * (self.micro_batches - 1)
+        )
+        self.step()
+        return jax.device_get(loss)
 
     def eval_batch(self, data_iter=None, batch=None, return_logits: bool = False):  # noqa: ARG002
         self.eval()
-        b = next(data_iter) if batch is None else batch
-        out = self.forward(b)
+        if not self._pipe_parallel:
+            b = next(data_iter) if batch is None else batch
+            out = self.forward(b)
+            self.train()
+            return out
+        combined = self._collect_batch(data_iter, batch)
+        out = super().forward(combined)
         self.train()
         return out
+
+    def _collect_batch(self, data_iter, batch):
+        """Concatenate gas microbatches into the full-step batch the spmd
+        pipeline slices internally (reference loads per-instruction,
+        pipe/engine.py:770)."""
+        if batch is not None:
+            return batch  # caller already passed the full-step batch
+        parts = [next(data_iter) for _ in range(self.micro_batches)]
+        if len(parts) == 1:
+            return parts[0]
+        return jax.tree_util.tree_map(lambda *ls: jnp.concatenate(ls, axis=0), *parts)
+
+    # --- disabled surfaces (reference pipe/engine.py:1290-1305) ----------
+    def forward(self, batch):
+        if self._pipe_parallel:
+            raise RuntimeError(
+                "PipelineEngine does not support forward(); use train_batch/eval_batch"
+            )
+        return super().forward(batch)
+
+    def backward(self, loss, **kwargs):
+        if self._pipe_parallel:
+            raise RuntimeError(
+                "PipelineEngine does not support backward(); use train_batch"
+            )
+        return super().backward(loss, **kwargs)
 
     def set_dataloader(self, loader) -> None:
         self.training_dataloader = loader
 
+    def set_batch_fn(self, fn) -> None:
+        self.batch_fn = fn
+
     def is_first_stage(self) -> bool:
+        """SPMD: every process spans all stages (stage = mesh coordinate)."""
         return True
 
     def is_last_stage(self) -> bool:
