@@ -1,0 +1,10 @@
+"""Elastic training (reference: ``deepspeed/elasticity/``)."""
+
+from deepspeed_tpu.elasticity.elasticity import (
+    compute_elastic_config,
+    elasticity_enabled,
+    ensure_immutable_elastic_config,
+    get_compatible_gpus_v01,
+    get_compatible_gpus_v02,
+)
+from deepspeed_tpu.elasticity.config import ElasticityConfig, ElasticityConfigError, ElasticityError

@@ -1,0 +1,106 @@
+"""Elasticity config (reference: ``deepspeed/elasticity/config.py``)."""
+
+from __future__ import annotations
+
+import json
+
+
+class ElasticityError(Exception):
+    """Base elasticity error."""
+
+
+class ElasticityConfigError(ElasticityError):
+    """Invalid elasticity config."""
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    """World size incompatible with the elastic config."""
+
+
+ELASTICITY = "elasticity"
+ENABLED = "enabled"
+ENABLED_DEFAULT = False
+MAX_ACCEPTABLE_BATCH_SIZE = "max_train_batch_size"
+MAX_ACCEPTABLE_BATCH_SIZE_DEFAULT = 2000
+MICRO_BATCHES = "micro_batch_sizes"
+MICRO_BATCHES_DEFAULT = [2, 4, 6]
+MIN_GPUS = "min_gpus"
+MIN_GPUS_DEFAULT = 1
+MAX_GPUS = "max_gpus"
+MAX_GPUS_DEFAULT = 10000
+NUM_GPUS_PER_NODE = "num_gpus_per_node"
+NUM_GPUS_PER_NODE_DEFAULT = 1
+MODEL_PARALLEL_SIZE = "model_parallel_size"
+MODEL_PARALLEL_SIZE_DEFAULT = 1
+MIN_TIME = "min_time"
+MIN_TIME_DEFAULT = 0
+VERSION = "version"
+VERSION_DEFAULT = 0.2
+IGNORE_NON_ELASTIC_BATCH_INFO = "ignore_non_elastic_batch_info"
+IGNORE_NON_ELASTIC_BATCH_INFO_DEFAULT = False
+PREFER_LARGER_BATCH = "prefer_larger_batch"
+PREFER_LARGER_BATCH_DEFAULT = True
+
+
+class ElasticityConfig:
+    """Typed view of the ``elasticity`` config block::
+
+        "elasticity": {
+          "enabled": true,
+          "max_train_batch_size": 2000,
+          "micro_batch_sizes": [2,4,6],
+          "min_gpus": 1, "max_gpus": 10000,
+          "min_time": 20,
+          "prefer_larger_batch": true,
+          "version": 0.2
+        }
+    """
+
+    def __init__(self, param_dict: dict):
+        self.enabled = param_dict.get(ENABLED, ENABLED_DEFAULT)
+        if MAX_ACCEPTABLE_BATCH_SIZE in param_dict:
+            self.max_acceptable_batch_size = param_dict[MAX_ACCEPTABLE_BATCH_SIZE]
+        else:
+            raise ElasticityConfigError(f"Elasticity config missing {MAX_ACCEPTABLE_BATCH_SIZE}")
+        if MICRO_BATCHES in param_dict:
+            self.micro_batches = param_dict[MICRO_BATCHES]
+        else:
+            raise ElasticityConfigError(f"Elasticity config missing {MICRO_BATCHES}")
+        if not isinstance(self.micro_batches, list):
+            raise ElasticityConfigError(
+                f"elasticity micro_batches must be a list, got {type(self.micro_batches)}"
+            )
+        if not all(map(lambda m: isinstance(m, int), self.micro_batches)):
+            raise ElasticityConfigError(f"micro_batches must be integers: {self.micro_batches}")
+        if not all(map(lambda m: m > 0, self.micro_batches)):
+            raise ElasticityConfigError(f"micro_batches must be > 0: {self.micro_batches}")
+
+        self.min_gpus = param_dict.get(MIN_GPUS, MIN_GPUS_DEFAULT)
+        self.max_gpus = param_dict.get(MAX_GPUS, MAX_GPUS_DEFAULT)
+        if self.min_gpus < 1 or self.max_gpus < 1:
+            raise ElasticityConfigError("Elasticity min/max gpus must be > 0")
+        if self.max_gpus < self.min_gpus:
+            raise ElasticityConfigError("Elasticity min_gpus cannot be greater than max_gpus")
+
+        self.model_parallel_size = param_dict.get(MODEL_PARALLEL_SIZE, MODEL_PARALLEL_SIZE_DEFAULT)
+        if self.model_parallel_size < 1:
+            raise ElasticityConfigError("Model-Parallel size cannot be less than 1")
+        self.num_gpus_per_node = param_dict.get(NUM_GPUS_PER_NODE, NUM_GPUS_PER_NODE_DEFAULT)
+        if self.num_gpus_per_node < 1:
+            raise ElasticityConfigError("Number of chips per node cannot be less than 1")
+
+        self.min_time = param_dict.get(MIN_TIME, MIN_TIME_DEFAULT)
+        if self.min_time < 0:
+            raise ElasticityConfigError(f"Elasticity min time needs to be >= 0: given {self.min_time}")
+
+        self.version = param_dict.get(VERSION, VERSION_DEFAULT)
+        self.prefer_larger_batch_size = param_dict.get(PREFER_LARGER_BATCH, PREFER_LARGER_BATCH_DEFAULT)
+        self.ignore_non_elastic_batch_info = param_dict.get(
+            IGNORE_NON_ELASTIC_BATCH_INFO, IGNORE_NON_ELASTIC_BATCH_INFO_DEFAULT
+        )
+
+    def repr(self) -> dict:
+        return self.__dict__
+
+    def __repr__(self) -> str:
+        return json.dumps(self.__dict__, sort_keys=True, indent=4)

@@ -1,0 +1,269 @@
+"""Elastic batch-size math.
+
+Counterpart of ``deepspeed/elasticity/elasticity.py``: given an acceptable
+max global batch, candidate micro-batch sizes, and chip-count bounds, find
+the (global batch, chip counts) combinations that keep the global batch
+FIXED as nodes join/leave — so training hyperparameters stay valid across
+resizes. v0.1 (`_get_compatible_gpus_v01` reference :83) ignores model
+parallelism; v0.2 (reference :126) requires chip counts divisible by
+mp_size × chips_per_node.
+
+All math is device-agnostic and applies to TPU slices unchanged (a "gpu"
+here is a chip).
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from deepspeed_tpu.elasticity.config import (
+    ElasticityConfig,
+    ElasticityConfigError,
+    ElasticityError,
+    ElasticityIncompatibleWorldSize,
+    ELASTICITY,
+    ENABLED,
+    ENABLED_DEFAULT,
+    IGNORE_NON_ELASTIC_BATCH_INFO,
+    IGNORE_NON_ELASTIC_BATCH_INFO_DEFAULT,
+    MODEL_PARALLEL_SIZE,
+    MODEL_PARALLEL_SIZE_DEFAULT,
+    NUM_GPUS_PER_NODE,
+    NUM_GPUS_PER_NODE_DEFAULT,
+)
+
+# accept any framework version >= this for elastic checkpoints
+MINIMUM_DEEPSPEED_VERSION = "0.0.1"
+LATEST_ELASTICITY_VERSION = 0.2
+
+
+def _all_divisors(n: int) -> List[int]:
+    out = []
+    i = 1
+    while i * i <= n:
+        if n % i == 0:
+            out.append(i)
+            if i != n // i:
+                out.append(n // i)
+        i += 1
+    return sorted(out)
+
+
+def get_candidate_batch_sizes(base_list: List[int], max_acceptable_batch_size: int) -> List[int]:
+    """All batch sizes ≤ max that are (micro_batch × power-of-2) highly
+    composite candidates (reference elasticity.py:48)."""
+    candidate_batch_size = set()
+    for base in base_list:
+        if base >= max_acceptable_batch_size:
+            candidate_batch_size.add(base)
+            continue
+        value = max_acceptable_batch_size // base
+        index = value.bit_length() - 1  # floor(log2(value))
+        candidate_batch_size.add(base * (2**index))
+    return sorted(candidate_batch_size)
+
+
+def get_valid_gpus(batch_size: int, micro_batches: List[int], min_valid_gpus: int, max_valid_gpus: int) -> List[int]:
+    """Chip counts g such that batch_size % (micro × g) == 0 for some micro
+    (reference elasticity.py:64)."""
+    valid_gpus = set()
+    for micro_batch in micro_batches:
+        if batch_size % micro_batch != 0:
+            continue
+        max_gpus = batch_size // micro_batch
+        for div in _all_divisors(max_gpus):
+            if min_valid_gpus <= div <= max_valid_gpus:
+                valid_gpus.add(div)
+    return sorted(valid_gpus)
+
+
+def get_compatible_gpus_v01(
+    micro_batches: List[int],
+    max_acceptable_batch_size: int,
+    min_gpus: int = 1,
+    max_gpus: int = 10000,
+    prefer_larger: bool = True,
+) -> Tuple[int, List[int]]:
+    """Pick the candidate batch size with the most valid chip counts
+    (reference `_get_compatible_gpus_v01` :83)."""
+    candidate_batch_sizes = get_candidate_batch_sizes(micro_batches, max_acceptable_batch_size)
+    final_batch_size = 0
+    valid_gpus: List[int] = []
+    for batch_size in candidate_batch_sizes:
+        current_valid_gpus = get_valid_gpus(batch_size, micro_batches, min_gpus, max_gpus)
+        if len(current_valid_gpus) > len(valid_gpus) or (
+            len(current_valid_gpus) == len(valid_gpus)
+            and prefer_larger
+            and batch_size > final_batch_size
+        ):
+            valid_gpus = current_valid_gpus
+            final_batch_size = batch_size
+    return final_batch_size, valid_gpus
+
+
+def get_compatible_gpus_v02(
+    micro_batches: List[int],
+    max_acceptable_batch_size: int,
+    current_num_gpus: int,
+    min_gpus: int = 1,
+    max_gpus: int = 10000,
+    prefer_larger: bool = True,
+    num_gpus_per_node: int = 1,
+    model_parallel_size: int = 1,
+):
+    """v0.2: model-parallel-aware (reference :126) — chip counts must be
+    multiples of mp_size × chips_per_node (whole model replicas on whole
+    nodes); returns (batch, valid counts, micro-batch for current size)."""
+    if model_parallel_size > 1 and model_parallel_size % num_gpus_per_node != 0:
+        raise ElasticityError(
+            f"model_parallel_size {model_parallel_size} must be a multiple of "
+            f"chips per node {num_gpus_per_node}"
+        )
+    dp_size_per_node = max(1, num_gpus_per_node // model_parallel_size) if model_parallel_size <= num_gpus_per_node else 1
+
+    final_batch_size, valid_world = get_compatible_gpus_v01(
+        micro_batches,
+        max_acceptable_batch_size=max_acceptable_batch_size // model_parallel_size,
+        min_gpus=min_gpus,
+        max_gpus=max_gpus // model_parallel_size,
+        prefer_larger=prefer_larger,
+    )
+    final_batch_size = int(final_batch_size) * model_parallel_size
+    valid_dp_world_sizes = [i * model_parallel_size for i in valid_world]
+    if current_num_gpus // model_parallel_size in valid_world:
+        return final_batch_size, valid_dp_world_sizes, current_num_gpus // model_parallel_size
+    return final_batch_size, valid_dp_world_sizes, None
+
+
+def get_best_candidates(candidate_batch_sizes, micro_batches, min_gpus, max_gpus, prefer_larger):
+    final_batch_size = 0
+    valid_gpus: List[int] = []
+    for batch_size in candidate_batch_sizes:
+        current_valid_gpus = get_valid_gpus(batch_size, micro_batches, min_gpus, max_gpus)
+        if len(current_valid_gpus) > len(valid_gpus) or (
+            len(current_valid_gpus) == len(valid_gpus)
+            and prefer_larger
+            and batch_size > final_batch_size
+        ):
+            valid_gpus = current_valid_gpus
+            final_batch_size = batch_size
+    return final_batch_size, valid_gpus
+
+
+def elasticity_enabled(ds_config: dict) -> bool:
+    if ELASTICITY not in ds_config:
+        return False
+    return ds_config[ELASTICITY].get(ENABLED, ENABLED_DEFAULT)
+
+
+def ensure_immutable_elastic_config(runtime_elastic_config_dict: dict) -> None:
+    """Elastic config in env must match runtime config (reference :181)."""
+    import json
+    import os
+
+    DEEPSPEED_ELASTICITY_CONFIG = "DEEPSPEED_ELASTICITY_CONFIG"
+    if DEEPSPEED_ELASTICITY_CONFIG in os.environ:
+        scheduler_elastic_config_dict = json.loads(os.environ[DEEPSPEED_ELASTICITY_CONFIG])
+        scheduler_elastic_config = ElasticityConfig(scheduler_elastic_config_dict)
+        runtime_elastic_config = ElasticityConfig(runtime_elastic_config_dict)
+        err_str = "Elastic config '{}={}' seen by scheduler does not match config passed to runtime '{}={}'"
+        if runtime_elastic_config.max_acceptable_batch_size != scheduler_elastic_config.max_acceptable_batch_size:
+            raise ElasticityConfigError(
+                err_str.format(
+                    "max_acceptable_batch_size",
+                    scheduler_elastic_config.max_acceptable_batch_size,
+                    "max_acceptable_batch_size",
+                    runtime_elastic_config.max_acceptable_batch_size,
+                )
+            )
+        if runtime_elastic_config.micro_batches != scheduler_elastic_config.micro_batches:
+            raise ElasticityConfigError(
+                err_str.format(
+                    "micro_batches",
+                    scheduler_elastic_config.micro_batches,
+                    "micro_batches",
+                    runtime_elastic_config.micro_batches,
+                )
+            )
+        if runtime_elastic_config.version != scheduler_elastic_config.version:
+            raise ElasticityConfigError(
+                err_str.format(
+                    "version", scheduler_elastic_config.version, "version", runtime_elastic_config.version
+                )
+            )
+    else:
+        os.environ[DEEPSPEED_ELASTICITY_CONFIG] = json.dumps(runtime_elastic_config_dict)
+
+
+def compute_elastic_config(ds_config: dict, target_deepspeed_version: str, world_size: int = 0, return_microbatch: bool = False):
+    """Core entry (reference `compute_elastic_config` :233): returns
+    (final_batch_size, valid_gpus[, micro_batch]) and validates world_size
+    when given."""
+    if ELASTICITY not in ds_config:
+        raise ElasticityConfigError(f"'{ELASTICITY}' is missing from config json")
+    elastic_config_dict = ds_config[ELASTICITY]
+    if not elastic_config_dict.get(ENABLED, ENABLED_DEFAULT):
+        raise ElasticityConfigError("Elasticity is not enabled in config json")
+    elastic_config = ElasticityConfig(elastic_config_dict)
+    model_parallel_size = elastic_config.model_parallel_size
+    num_gpus_per_node = elastic_config.num_gpus_per_node
+
+    if model_parallel_size > 1 and float(elastic_config.version) != 0.2:
+        raise ElasticityConfigError(
+            "Elasticity V{} does not support model-parallel training".format(elastic_config.version)
+        )
+    if float(elastic_config.version) > LATEST_ELASTICITY_VERSION:
+        raise ElasticityConfigError(
+            "Attempting to run elasticity version {} but runtime only supports up "
+            "to {}".format(elastic_config.version, LATEST_ELASTICITY_VERSION)
+        )
+
+    micro_batch = None
+    if float(elastic_config.version) == 0.1:
+        final_batch_size, valid_gpus = get_compatible_gpus_v01(
+            micro_batches=elastic_config.micro_batches,
+            max_acceptable_batch_size=elastic_config.max_acceptable_batch_size,
+            min_gpus=elastic_config.min_gpus,
+            max_gpus=elastic_config.max_gpus,
+            prefer_larger=elastic_config.prefer_larger_batch_size,
+        )
+    elif float(elastic_config.version) == 0.2:
+        if world_size != 0:
+            current_num_gpus = world_size
+        else:
+            import os
+
+            current_num_gpus = int(os.environ.get("WORLD_SIZE", 1))
+        final_batch_size, valid_gpus, candidate_microbatch_size = get_compatible_gpus_v02(
+            micro_batches=elastic_config.micro_batches,
+            max_acceptable_batch_size=elastic_config.max_acceptable_batch_size,
+            current_num_gpus=current_num_gpus,
+            min_gpus=elastic_config.min_gpus,
+            max_gpus=elastic_config.max_gpus,
+            prefer_larger=elastic_config.prefer_larger_batch_size,
+            num_gpus_per_node=num_gpus_per_node,
+            model_parallel_size=model_parallel_size,
+        )
+        micro_batch = candidate_microbatch_size
+    else:
+        raise NotImplementedError(f"Unable to find elastic logic for version: {elastic_config.version}")
+
+    if world_size > 0:
+        if world_size not in valid_gpus:
+            raise ElasticityIncompatibleWorldSize(
+                f"World size ({world_size}) is not valid with the current list of "
+                f"valid chip counts: {valid_gpus}"
+            )
+        # chosen micro batch: largest micro that divides batch/world evenly
+        if micro_batch is None:
+            candidates = [
+                mb
+                for mb in elastic_config.micro_batches
+                if final_batch_size % (mb * world_size) == 0
+            ]
+            micro_batch = max(candidates) if candidates else None
+        if return_microbatch or micro_batch is not None:
+            return final_batch_size, valid_gpus, micro_batch
+    if return_microbatch:
+        return final_batch_size, valid_gpus, micro_batch
+    return final_batch_size, valid_gpus

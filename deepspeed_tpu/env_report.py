@@ -1,0 +1,119 @@
+"""Environment / compatibility report (``ds_report`` CLI).
+
+Counterpart of the reference's ``deepspeed/env_report.py``: prints framework
+versions, accelerator status, and the op/kernels compatibility matrix so
+users can diagnose an install at a glance.
+"""
+
+from __future__ import annotations
+
+import sys
+
+GREEN = "\033[92m"
+RED = "\033[91m"
+YELLOW = "\033[93m"
+END = "\033[0m"
+SUCCESS = f"{GREEN}[YES]{END}"
+WARNING = f"{YELLOW}[WARNING]{END}"
+FAIL = f"{RED}[NO]{END}"
+OKAY = f"{GREEN}[OKAY]{END}"
+
+
+def op_report():
+    """Pallas/XLA op availability matrix (the reference's JIT/AOT native-op
+    compat table, env_report.py op_report)."""
+    rows = []
+    try:
+        from deepspeed_tpu.ops.transformer.flash_attention import flash_attention  # noqa: F401
+
+        rows.append(("flash_attention (pallas)", True))
+    except ImportError:
+        rows.append(("flash_attention (pallas)", False))
+    for name, modpath in [
+        ("fused_adam", "deepspeed_tpu.ops.adam.fused_adam"),
+        ("fused_lamb", "deepspeed_tpu.ops.lamb.fused_lamb"),
+        ("cpu_adagrad", "deepspeed_tpu.ops.adagrad.cpu_adagrad"),
+    ]:
+        try:
+            __import__(modpath)
+            rows.append((name, True))
+        except ImportError:
+            rows.append((name, False))
+    try:
+        from deepspeed_tpu.ops.aio import AsyncIOBuilder
+
+        rows.append(("async_io (native)", AsyncIOBuilder().is_compatible()))
+    except ImportError:
+        rows.append(("async_io (native)", False))
+    try:
+        from deepspeed_tpu.ops.adam.cpu_adam_native import native_adam_available
+
+        rows.append(("cpu_adam (native AVX)", native_adam_available()))
+    except ImportError:
+        rows.append(("cpu_adam (native AVX)", False))
+
+    max_dots = max(len(n) for n, _ in rows) + 4
+    print("-" * 70)
+    print("op name" + "." * (max_dots - 7) + " compatible")
+    print("-" * 70)
+    for name, ok in rows:
+        print(name + "." * (max_dots - len(name)) + f" {SUCCESS if ok else FAIL}")
+    print("-" * 70)
+    return rows
+
+
+def debug_report():
+    import deepspeed_tpu
+
+    try:
+        import jax
+
+        jax_version = jax.__version__
+        try:
+            devices = jax.devices()
+            platform = devices[0].platform
+            device_count = len(devices)
+        except Exception as e:
+            platform, device_count = f"unavailable ({e})", 0
+    except ImportError:
+        jax_version, platform, device_count = "not installed", "-", 0
+
+    try:
+        import flax
+
+        flax_version = flax.__version__
+    except ImportError:
+        flax_version = "not installed"
+    try:
+        import optax
+
+        optax_version = optax.__version__
+    except ImportError:
+        optax_version = "not installed"
+
+    report = [
+        ("deepspeed_tpu install path", deepspeed_tpu.__path__),
+        ("deepspeed_tpu version", deepspeed_tpu.__version__),
+        ("jax version", jax_version),
+        ("flax version", flax_version),
+        ("optax version", optax_version),
+        ("platform", platform),
+        ("device count", device_count),
+        ("python version", sys.version.split()[0]),
+    ]
+    print("DeepSpeed-TPU general environment info:")
+    for name, value in report:
+        print(f"{name} ................... {value}")
+
+
+def main():
+    op_report()
+    debug_report()
+
+
+def cli_main():
+    main()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,161 @@
+"""Per-node launcher.
+
+Counterpart of the reference's ``deepspeed/launcher/launch.py:132``: decodes
+``--world_info``, sets the distributed environment, forks worker processes,
+and owns their lifecycle (signal forwarding + process-tree cleanup,
+reference launch.py:118).
+
+TPU-native delta: the reference forks ``num_local_procs`` = one OS process
+per GPU; a TPU host runs ONE worker process that drives all local chips
+through the device mesh, so ``local_procs`` defaults to 1 and ``LOCAL_RANK``
+is always 0. (``--procs_per_node`` exists for CPU-mesh simulation tests.)
+
+Environment contract (read by ``deepspeed_tpu.comm.init_distributed``):
+``RANK``, ``WORLD_SIZE``, ``LOCAL_RANK``, ``MASTER_ADDR``, ``MASTER_PORT``.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from argparse import ArgumentParser, REMAINDER
+from typing import List
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def parse_args(args=None):
+    parser = ArgumentParser(description="deepspeed_tpu per-node launcher")
+    parser.add_argument(
+        "--node_rank",
+        type=int,
+        default=0,
+        help="rank of this node in the multi-node deployment",
+    )
+    parser.add_argument(
+        "--master_addr",
+        default="127.0.0.1",
+        type=str,
+        help="coordinator address (rank-0 node)",
+    )
+    parser.add_argument("--master_port", default=29500, type=int)
+    parser.add_argument(
+        "--world_info",
+        default="None",
+        type=str,
+        help="base64-encoded dict host → local slot list",
+    )
+    parser.add_argument(
+        "--procs_per_node",
+        type=int,
+        default=1,
+        help="worker processes per node (1 on TPU: chips are mesh-addressed)",
+    )
+    parser.add_argument("--module", action="store_true")
+    parser.add_argument("--no_python", action="store_true")
+    parser.add_argument("--save_pid", type=int, default=0)
+    parser.add_argument("training_script", type=str)
+    parser.add_argument("training_script_args", nargs=REMAINDER)
+    return parser.parse_args(args=args)
+
+
+def decode_world_info(encoded: str) -> dict:
+    if encoded in ("None", "", None):
+        return {}
+    decoded = base64.urlsafe_b64decode(encoded)
+    return json.loads(decoded)
+
+
+def encode_world_info(world_info: dict) -> str:
+    json_str = json.dumps(world_info)
+    return base64.urlsafe_b64encode(json_str.encode()).decode()
+
+
+def build_child_env(args, node_rank: int, num_nodes: int, local_rank: int) -> dict:
+    env = os.environ.copy()
+    procs = args.procs_per_node
+    world_size = num_nodes * procs
+    rank = node_rank * procs + local_rank
+    env["RANK"] = str(rank)
+    env["LOCAL_RANK"] = str(local_rank)
+    env["WORLD_SIZE"] = str(world_size)
+    env["MASTER_ADDR"] = args.master_addr
+    env["MASTER_PORT"] = str(args.master_port)
+    # standard JAX cluster envs for jax.distributed auto-init
+    env["COORDINATOR_ADDRESS"] = f"{args.master_addr}:{args.master_port}"
+    return env
+
+
+def main(args=None):
+    args = parse_args(args)
+    world_info = decode_world_info(args.world_info)
+    if world_info:
+        num_nodes = len(world_info)
+        node_hosts = list(world_info.keys())
+        logger.info(
+            f"nnodes={num_nodes}, node_rank={args.node_rank}, hosts={node_hosts}"
+        )
+    else:
+        num_nodes = 1
+
+    processes: List[subprocess.Popen] = []
+    for local_rank in range(args.procs_per_node):
+        env = build_child_env(args, args.node_rank, num_nodes, local_rank)
+        cmd = []
+        if not args.no_python:
+            cmd = [sys.executable, "-u"]
+            if args.module:
+                cmd.append("-m")
+        else:
+            if args.module:
+                raise ValueError("--module and --no_python cannot be used together")
+        cmd.append(args.training_script)
+        cmd += args.training_script_args
+        logger.info(f"launch rank={env['RANK']}: {' '.join(cmd)}")
+        processes.append(subprocess.Popen(cmd, env=env))
+
+    sig_names = {2: "SIGINT", 15: "SIGTERM"}
+    last_return_code = None
+
+    def sigkill_handler(signum, frame):  # noqa: ARG001
+        """Kill the whole worker tree on signal (reference launch.py:118)."""
+        for process in processes:
+            logger.info(f"Killing subprocess {process.pid}")
+            try:
+                process.kill()
+            except Exception:
+                pass
+        if last_return_code is not None:
+            logger.error(f"{processes[-1].args} exits with return code = {last_return_code}")
+            sys.exit(last_return_code)
+        if signum in sig_names:
+            logger.info(f"Main process received {sig_names[signum]}, exiting")
+        sys.exit(1)
+
+    signal.signal(signal.SIGINT, sigkill_handler)
+    signal.signal(signal.SIGTERM, sigkill_handler)
+
+    alive = list(processes)
+    while alive:
+        finished = []
+        for process in alive:
+            rc = process.poll()
+            if rc is None:
+                continue
+            finished.append(process)
+            if rc != 0:
+                last_return_code = rc
+                sigkill_handler(signal.SIGTERM, None)
+        alive = [p for p in alive if p not in finished]
+        if alive:
+            time.sleep(0.5)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,266 @@
+"""Multi-node runner command builders.
+
+Counterpart of the reference's ``deepspeed/launcher/multinode_runner.py``
+(PDSHRunner :51, OpenMPIRunner :109, MPICHRunner :162, IMPIRunner :233,
+SlurmRunner :315, MVAPICHRunner :363). Each runner turns (args, world_info,
+environment) into the command line that starts one launcher process per
+node. TPU-native deltas: one worker process per HOST (chips are addressed
+through the in-process mesh, so there is no per-device fork), and the
+exported environment carries the JAX coordinator instead of NCCL vars.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from abc import ABC, abstractmethod
+from shlex import quote
+
+from deepspeed_tpu.launcher.constants import MVAPICH_TMP_HOSTFILE, PDSH_MAX_FAN_OUT
+from deepspeed_tpu.utils.logging import logger
+
+
+class MultiNodeRunner(ABC):
+    def __init__(self, args, world_info_base64: str):
+        self.args = args
+        self.user_arguments = self.parse_user_args()
+        self.user_script = args.user_script
+        self.world_info_base64 = world_info_base64
+        self.exports = {}
+
+    @abstractmethod
+    def get_cmd(self, environment, active_resources):
+        """Return the command to launch distributed training."""
+
+    def add_export(self, key: str, var: str) -> None:
+        self.exports[key.strip()] = var.strip()
+
+    def parse_user_args(self):
+        return self.args.user_args
+
+    @property
+    def name(self) -> str:
+        return self.__class__.__name__
+
+    def backend_exists(self) -> bool:
+        return True
+
+    def validate_args(self) -> None:
+        pass
+
+
+class PDSHRunner(MultiNodeRunner):
+    """Default ssh fan-out (reference :51)."""
+
+    def backend_exists(self) -> bool:
+        return bool(shutil.which("pdsh"))
+
+    def get_cmd(self, environment, active_resources):
+        environment["PDSH_RCMD_TYPE"] = "ssh"
+        active_workers = ",".join(active_resources.keys())
+        logger.info(f"Running on the following workers: {active_workers}")
+
+        pdsh_cmd_args = [
+            "pdsh",
+            "-S",
+            "-f",
+            str(PDSH_MAX_FAN_OUT),
+            "-w",
+            active_workers,
+        ]
+        exports = ""
+        for key, val in self.exports.items():
+            exports += f"export {key}={quote(val)}; "
+
+        # launch one per-node launcher on each host; it forks the worker(s)
+        deepspeed_launch = [
+            exports,
+            f"cd {os.path.abspath('.')};",
+            "python",
+            "-u",
+            "-m",
+            "deepspeed_tpu.launcher.launch",
+            f"--world_info={self.world_info_base64}",
+            "--node_rank=%n",
+            f"--master_addr={self.args.master_addr}",
+            f"--master_port={self.args.master_port}",
+        ]
+        if getattr(self.args, "no_python", False):
+            deepspeed_launch.append("--no_python")
+        if getattr(self.args, "module", False):
+            deepspeed_launch.append("--module")
+        return pdsh_cmd_args + deepspeed_launch + [self.user_script] + [
+            quote(a) for a in self.user_arguments
+        ]
+
+
+class OpenMPIRunner(MultiNodeRunner):
+    """mpirun -hostfile launcher (reference :109)."""
+
+    def __init__(self, args, world_info_base64, resource_pool):
+        super().__init__(args, world_info_base64)
+        self.resource_pool = resource_pool
+
+    def backend_exists(self) -> bool:
+        return bool(shutil.which("ompi_info"))
+
+    def validate_args(self) -> None:
+        if self.args.include != "" or self.args.exclude != "":
+            raise ValueError(f"{self.name} backend does not support --include/--exclude")
+
+    def get_cmd(self, environment, active_resources):  # noqa: ARG002
+        total_process_count = len(self.resource_pool)  # one proc per host
+        mpirun_cmd = [
+            "mpirun",
+            "-n",
+            f"{total_process_count}",
+            "-hostfile",
+            f"{self.args.hostfile}",
+            "--mca",
+            "btl",
+            "^openib",
+            "--mca",
+            "btl_tcp_if_include",
+            "eth0",
+        ]
+        export_cmd = []
+        for k, v in self.exports.items():
+            export_cmd += ["-x", f"{k}={quote(v)}"]
+        python_exec = [] if getattr(self.args, "no_python", False) else ["python", "-u"]
+        if getattr(self.args, "module", False):
+            python_exec.append("-m")
+        return mpirun_cmd + export_cmd + python_exec + [self.user_script] + self.user_arguments
+
+
+class MPICHRunner(MultiNodeRunner):
+    """(reference :162)"""
+
+    def __init__(self, args, world_info_base64, resource_pool):
+        super().__init__(args, world_info_base64)
+        self.resource_pool = resource_pool
+
+    def backend_exists(self) -> bool:
+        return bool(shutil.which("mpirun"))
+
+    def validate_args(self) -> None:
+        if self.args.include != "" or self.args.exclude != "":
+            raise ValueError(f"{self.name} backend does not support --include/--exclude")
+
+    def get_cmd(self, environment, active_resources):  # noqa: ARG002
+        total_process_count = len(self.resource_pool)
+        mpirun_cmd = [
+            "mpirun",
+            "-n",
+            f"{total_process_count}",
+            "-ppn",
+            "1",
+        ]
+        export_cmd = []
+        for k, v in self.exports.items():
+            export_cmd += ["-genv", k, quote(v)]
+        python_exec = [] if getattr(self.args, "no_python", False) else ["python", "-u"]
+        if getattr(self.args, "module", False):
+            python_exec.append("-m")
+        return mpirun_cmd + export_cmd + python_exec + [self.user_script] + self.user_arguments
+
+
+class IMPIRunner(MultiNodeRunner):
+    """Intel MPI (reference :233)."""
+
+    def __init__(self, args, world_info_base64, resource_pool):
+        super().__init__(args, world_info_base64)
+        self.resource_pool = resource_pool
+
+    def backend_exists(self) -> bool:
+        return bool(shutil.which("mpirun"))
+
+    def validate_args(self) -> None:
+        if self.args.include != "" or self.args.exclude != "":
+            raise ValueError(f"{self.name} backend does not support --include/--exclude")
+
+    def get_cmd(self, environment, active_resources):  # noqa: ARG002
+        total = len(self.resource_pool)
+        cmd = ["mpirun", "-ppn", "1"]
+        for k, v in self.exports.items():
+            cmd += ["-genv", k, quote(v)]
+        for rank, host in enumerate(self.resource_pool.keys()):
+            cmd += ["-host", host, "-n", "1"]
+            python_exec = [] if getattr(self.args, "no_python", False) else ["python", "-u"]
+            if getattr(self.args, "module", False):
+                python_exec.append("-m")
+            cmd += python_exec + [self.user_script] + self.user_arguments
+            if rank != total - 1:
+                cmd += [":"]
+        return cmd
+
+
+class SlurmRunner(MultiNodeRunner):
+    """srun launcher (reference :315)."""
+
+    def __init__(self, args, world_info_base64, resource_pool):
+        super().__init__(args, world_info_base64)
+        self.resource_pool = resource_pool
+
+    def backend_exists(self) -> bool:
+        return bool(shutil.which("sinfo"))
+
+    def get_cmd(self, environment, active_resources):  # noqa: ARG002
+        assert not getattr(self.args, "detect_nvlink_pairs", False)
+        srun_cmd = [
+            "srun",
+            "-n",
+            f"{len(self.resource_pool)}",
+            "--ntasks-per-node=1",
+        ]
+        if getattr(self.args, "comment", ""):
+            srun_cmd += ["--comment", self.args.comment]
+        if self.args.include != "":
+            srun_cmd += ["--include", f"{self.args.include}"]
+        if self.args.exclude != "":
+            srun_cmd += ["--exclude", f"{self.args.exclude}"]
+        if getattr(self.args, "num_nodes", -1) > 0:
+            srun_cmd += ["--nodes", f"{self.args.num_nodes}"]
+
+        exports = ""
+        for key, val in self.exports.items():
+            exports += f",{key}={val}"
+        python_exec = ["python", "-u"]
+        return srun_cmd + [f"--export=ALL{exports}"] + python_exec + [self.user_script] + self.user_arguments
+
+
+class MVAPICHRunner(MultiNodeRunner):
+    """(reference :363)"""
+
+    def __init__(self, args, world_info_base64, resource_pool):
+        super().__init__(args, world_info_base64)
+        self.resource_pool = resource_pool
+
+    def backend_exists(self) -> bool:
+        if not shutil.which("mpiname"):
+            return False
+        try:
+            results = subprocess.check_output(["mpiname"], text=True)
+        except (subprocess.CalledProcessError, OSError):
+            return False
+        return "MVAPICH2-GDR" in results
+
+    def get_cmd(self, environment, active_resources):  # noqa: ARG002
+        with open(MVAPICH_TMP_HOSTFILE, "w") as fd:
+            for host in self.resource_pool.keys():
+                fd.write(f"{host}\n")
+        total = len(self.resource_pool)
+        mpirun_cmd = [
+            "mpirun",
+            "-np",
+            f"{total}",
+            "--hostfile",
+            MVAPICH_TMP_HOSTFILE,
+        ]
+        export_cmd = []
+        for k, v in self.exports.items():
+            export_cmd += ["-env", f"{k}={quote(v)}"]
+        python_exec = [] if getattr(self.args, "no_python", False) else ["python", "-u"]
+        if getattr(self.args, "module", False):
+            python_exec.append("-m")
+        return mpirun_cmd + export_cmd + python_exec + [self.user_script] + self.user_arguments

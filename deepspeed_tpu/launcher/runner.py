@@ -1,0 +1,272 @@
+"""``deepspeed`` CLI entry (reference: ``deepspeed/launcher/runner.py:389``).
+
+Parses the hostfile and resource filters, encodes the world info, chooses a
+multinode runner (pdsh default), and either execs the per-node launcher
+locally (single node) or the runner's fan-out command.
+
+Hostfile syntax matches the reference (runner.py:201)::
+
+    worker-1 slots=4
+    worker-2 slots=4
+
+On TPU a "slot" is a host-attached chip; the per-node launcher still starts
+ONE worker process per host (chips are mesh-addressed in-process), so slots
+inform topology metadata rather than fork count.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import subprocess
+import sys
+from collections import OrderedDict
+from typing import Dict
+
+from deepspeed_tpu.launcher.constants import (
+    IMPI_LAUNCHER,
+    MPICH_LAUNCHER,
+    MVAPICH_LAUNCHER,
+    OPENMPI_LAUNCHER,
+    PDSH_LAUNCHER,
+    SLURM_LAUNCHER,
+)
+from deepspeed_tpu.launcher.launch import encode_world_info
+from deepspeed_tpu.launcher.multinode_runner import (
+    IMPIRunner,
+    MPICHRunner,
+    MVAPICHRunner,
+    MultiNodeRunner,
+    OpenMPIRunner,
+    PDSHRunner,
+    SlurmRunner,
+)
+from deepspeed_tpu.utils.logging import logger
+
+DLTS_HOSTFILE = "/job/hostfile"
+EXPORT_ENVS = ["PYTHONPATH", "PATH", "TPU_", "JAX_", "XLA_", "LIBTPU_", "DS_"]
+DEEPSPEED_ENVIRONMENT_NAME = ".deepspeed_env"
+DEEPSPEED_ENVIRONMENT_PATHS = [".", os.path.expanduser("~")]
+PDSH_MAX_FAN_OUT = 1024
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(
+        description="deepspeed_tpu distributed launcher",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter,
+    )
+    parser.add_argument("-H", "--hostfile", type=str, default=DLTS_HOSTFILE,
+                        help="Hostfile path (host slots=n per line)")
+    parser.add_argument("-i", "--include", type=str, default="",
+                        help='Include hosts/slots, e.g. "worker-0@worker-1:0,2"')
+    parser.add_argument("-e", "--exclude", type=str, default="",
+                        help='Exclude hosts/slots, e.g. "worker-1:0"')
+    parser.add_argument("--num_nodes", type=int, default=-1,
+                        help="Number of nodes to run on (from hostfile)")
+    parser.add_argument("--num_gpus", "--num_accelerators", type=int, default=-1,
+                        dest="num_gpus", help="Max chips per node")
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--master_addr", type=str, default="")
+    parser.add_argument("--launcher", type=str, default=PDSH_LAUNCHER,
+                        choices=[PDSH_LAUNCHER, OPENMPI_LAUNCHER, MPICH_LAUNCHER,
+                                 IMPI_LAUNCHER, SLURM_LAUNCHER, MVAPICH_LAUNCHER])
+    parser.add_argument("--launcher_args", type=str, default="")
+    parser.add_argument("--module", action="store_true")
+    parser.add_argument("--no_python", action="store_true")
+    parser.add_argument("--no_local_rank", action="store_true")
+    parser.add_argument("--no_ssh_check", action="store_true")
+    parser.add_argument("--force_multi", action="store_true")
+    parser.add_argument("--save_pid", action="store_true")
+    parser.add_argument("--autotuning", type=str, default="",
+                        choices=["", "tune", "run"],
+                        help="Run the autotuner to discover optimal config")
+    parser.add_argument("--elastic_training", action="store_true")
+    parser.add_argument("user_script", type=str, help="User script to launch")
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args=args)
+
+
+def fetch_hostfile(hostfile_path: str) -> Dict[str, int]:
+    """Parse ``host slots=n`` lines (reference runner.py:201)."""
+    if not os.path.isfile(hostfile_path):
+        logger.debug(f"Unable to find hostfile at {hostfile_path}")
+        return {}
+    resource_pool: Dict[str, int] = OrderedDict()
+    with open(hostfile_path) as fd:
+        for line in fd:
+            line = line.strip()
+            if line == "" or line.startswith("#"):
+                continue
+            try:
+                hostname, slots = line.split()
+                _, slot_count = slots.split("=")
+                slot_count = int(slot_count)
+            except ValueError:
+                logger.error(f"Hostfile is not formatted correctly, unable to proceed: {line!r}")
+                raise ValueError(f"hostfile line malformed: {line!r}")
+            if hostname in resource_pool:
+                logger.error(f"Hostfile contains duplicate hosts, unable to proceed: {hostname}")
+                raise ValueError(f"duplicate host {hostname}")
+            resource_pool[hostname] = slot_count
+    return resource_pool
+
+
+def _parse_hostfile_filter(spec: str) -> Dict[str, list]:
+    """Parse an include/exclude string ``host1@host2:0,2`` → {host: [slots]}
+    (reference runner.py:256 ``parse_resource_filter``)."""
+    result: Dict[str, list] = OrderedDict()
+    if spec == "":
+        return result
+    for node_spec in spec.split("@"):
+        if ":" in node_spec:
+            host, slot_str = node_spec.split(":")
+            slots = [int(s) for s in slot_str.split(",")]
+            result[host] = slots
+        else:
+            result[node_spec] = []
+    return result
+
+
+def parse_resource_filter(
+    host_info: Dict[str, int], include_str: str = "", exclude_str: str = ""
+) -> Dict[str, list]:
+    """Apply include/exclude filters to the resource pool
+    (reference runner.py:256)."""
+    if include_str and exclude_str:
+        raise ValueError("include_str and exclude_str are mutually exclusive")
+
+    pool: Dict[str, list] = OrderedDict(
+        (host, list(range(slots))) for host, slots in host_info.items()
+    )
+    if include_str:
+        include = _parse_hostfile_filter(include_str)
+        filtered: Dict[str, list] = OrderedDict()
+        for host, slots in include.items():
+            if host not in pool:
+                raise ValueError(f"include host {host} not in hostfile")
+            use = slots if slots else pool[host]
+            for s in use:
+                if s not in pool[host]:
+                    raise ValueError(f"include slot {host}:{s} not available")
+            filtered[host] = use
+        return filtered
+    if exclude_str:
+        exclude = _parse_hostfile_filter(exclude_str)
+        for host, slots in exclude.items():
+            if host not in pool:
+                raise ValueError(f"exclude host {host} not in hostfile")
+            if slots:
+                pool[host] = [s for s in pool[host] if s not in slots]
+                if not pool[host]:
+                    del pool[host]
+            else:
+                del pool[host]
+    return pool
+
+
+def parse_inclusion_exclusion(resource_pool, inclusion, exclusion):
+    return parse_resource_filter(dict(resource_pool), include_str=inclusion, exclude_str=exclusion)
+
+
+def encode_world_info_from_pool(active_resources: Dict[str, list]) -> str:
+    return encode_world_info(active_resources)
+
+
+def main(args=None):
+    args = parse_args(args)
+
+    if args.autotuning:
+        from deepspeed_tpu.autotuning.autotuner import run_autotuning
+
+        return run_autotuning(args)
+
+    resource_pool = fetch_hostfile(args.hostfile)
+
+    # single-node shortcut: no hostfile → run the per-node launcher directly
+    multi_node = bool(resource_pool) and (len(resource_pool) > 1 or args.force_multi)
+    if not multi_node:
+        env = os.environ.copy()
+        master = args.master_addr or "127.0.0.1"
+        cmd = [
+            sys.executable,
+            "-u",
+            "-m",
+            "deepspeed_tpu.launcher.launch",
+            "--world_info=None",
+            "--node_rank=0",
+            f"--master_addr={master}",
+            f"--master_port={args.master_port}",
+        ]
+        if args.module:
+            cmd.append("--module")
+        if args.no_python:
+            cmd.append("--no_python")
+        cmd.append(args.user_script)
+        cmd += args.user_args
+        logger.info(f"cmd = {' '.join(cmd)}")
+        result = subprocess.Popen(cmd, env=env)
+        result.wait()
+        return result.returncode
+
+    active_resources = parse_inclusion_exclusion(resource_pool, args.include, args.exclude)
+    if args.num_nodes > 0:
+        hosts = list(active_resources.keys())[: args.num_nodes]
+        active_resources = OrderedDict((h, active_resources[h]) for h in hosts)
+    if args.num_gpus > 0:
+        active_resources = OrderedDict(
+            (h, s[: args.num_gpus]) for h, s in active_resources.items()
+        )
+    if not args.master_addr:
+        first_host = re.split(r"[:,@]", list(active_resources.keys())[0])[0]
+        args.master_addr = first_host
+
+    world_info_base64 = encode_world_info(active_resources)
+
+    runner: MultiNodeRunner
+    if args.launcher == PDSH_LAUNCHER:
+        runner = PDSHRunner(args, world_info_base64)
+    elif args.launcher == OPENMPI_LAUNCHER:
+        runner = OpenMPIRunner(args, world_info_base64, active_resources)
+    elif args.launcher == MPICH_LAUNCHER:
+        runner = MPICHRunner(args, world_info_base64, active_resources)
+    elif args.launcher == IMPI_LAUNCHER:
+        runner = IMPIRunner(args, world_info_base64, active_resources)
+    elif args.launcher == SLURM_LAUNCHER:
+        runner = SlurmRunner(args, world_info_base64, active_resources)
+    elif args.launcher == MVAPICH_LAUNCHER:
+        runner = MVAPICHRunner(args, world_info_base64, active_resources)
+    else:
+        raise NotImplementedError(f"Unknown launcher {args.launcher}")
+
+    if not runner.backend_exists():
+        raise RuntimeError(f"launcher '{args.launcher}' not installed")
+    runner.validate_args()
+
+    # export environment: whitelist prefixes + .deepspeed_env extras
+    curr_path = os.path.abspath(".")
+    env = os.environ.copy()
+    if "PYTHONPATH" in env:
+        env["PYTHONPATH"] = curr_path + ":" + env["PYTHONPATH"]
+    else:
+        env["PYTHONPATH"] = curr_path
+    for var, val in env.items():
+        if any(var.startswith(name) for name in EXPORT_ENVS):
+            runner.add_export(var, val)
+    for environ_path in DEEPSPEED_ENVIRONMENT_PATHS:
+        environ_file = os.path.join(environ_path, DEEPSPEED_ENVIRONMENT_NAME)
+        if os.path.isfile(environ_file):
+            with open(environ_file) as fd:
+                for line in fd.readlines():
+                    key, val = line.strip().split("=", 1)
+                    runner.add_export(key, val)
+
+    cmd = runner.get_cmd(env, active_resources)
+    logger.info(f"cmd = {' '.join(cmd)}")
+    result = subprocess.Popen(cmd, env=env)
+    result.wait()
+    return result.returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -634,6 +634,14 @@ class DeepSpeedEngine:
             return [batch]
         leaves = jax.tree_util.tree_leaves(batch)
         B = np.shape(leaves[0])[0]
+        expected = self.train_batch_size()
+        if B != expected and not getattr(self, "_warned_step_batch", False):
+            self._warned_step_batch = True
+            logger.warning(
+                f"train_batch(batch=...) got leading dim {B} but the config batch "
+                f"triad implies a full-step batch of {expected}; slicing into "
+                f"{gas} microbatches of {B // gas}"
+            )
         if B % gas != 0:
             raise ValueError(
                 f"train_batch(batch=...) leading dim {B} is not divisible by "

@@ -31,6 +31,7 @@ class PipelineEngine(DeepSpeedEngine):
         self.num_stages = self.topology.get_pipe_parallel_world_size()
         self.micro_batches = self.gradient_accumulation_steps()
         self._pipe_parallel = self.num_stages > 1
+        self.batch_fn = None
         if self._pipe_parallel:
             # all microbatches flow through ONE fwd_bwd whose loss is already
             # the microbatch mean → no further division by gas at step time
@@ -50,7 +51,8 @@ class PipelineEngine(DeepSpeedEngine):
         (reference pipe/engine.py:297)."""
         self.train()
         if not self._pipe_parallel:
-            return super().train_batch(data_iter=data_iter, batch=batch)
+            combined = self._collect_batch(data_iter, batch)
+            return super().train_batch(batch=combined)
         combined = self._collect_batch(data_iter, batch)
         loss = super().forward(combined)
         self._in_forward = False
@@ -67,12 +69,10 @@ class PipelineEngine(DeepSpeedEngine):
         return jax.device_get(loss)
 
     def eval_batch(self, data_iter=None, batch=None, return_logits: bool = False):  # noqa: ARG002
+        """Evaluate over a full step's worth of microbatches — consumes
+        ``micro_batches`` items from ``data_iter`` at ANY pipe size (the
+        reference contract, pipe/engine.py:404)."""
         self.eval()
-        if not self._pipe_parallel:
-            b = next(data_iter) if batch is None else batch
-            out = self.forward(b)
-            self.train()
-            return out
         combined = self._collect_batch(data_iter, batch)
         out = super().forward(combined)
         self.train()
@@ -81,13 +81,21 @@ class PipelineEngine(DeepSpeedEngine):
     def _collect_batch(self, data_iter, batch):
         """Concatenate gas microbatches into the full-step batch the spmd
         pipeline slices internally (reference loads per-instruction,
-        pipe/engine.py:770)."""
+        pipe/engine.py:770). Applies ``batch_fn`` when set."""
         if batch is not None:
-            return batch  # caller already passed the full-step batch
-        parts = [next(data_iter) for _ in range(self.micro_batches)]
-        if len(parts) == 1:
-            return parts[0]
-        return jax.tree_util.tree_map(lambda *ls: jnp.concatenate(ls, axis=0), *parts)
+            combined = batch  # caller already passed the full-step batch
+        else:
+            parts = [next(data_iter) for _ in range(self.micro_batches)]
+            if self.batch_fn is not None:
+                parts = [self.batch_fn(p) for p in parts]
+            combined = (
+                parts[0]
+                if len(parts) == 1
+                else jax.tree_util.tree_map(lambda *ls: jnp.concatenate(ls, axis=0), *parts)
+            )
+        if batch is not None and self.batch_fn is not None:
+            combined = self.batch_fn(combined)
+        return combined
 
     # --- disabled surfaces (reference pipe/engine.py:1290-1305) ----------
     def forward(self, batch):

@@ -156,7 +156,12 @@ class PipelineModule(DSModule):
 
         layers = self.build_layers()
         params = []
-        x = batch[0] if isinstance(batch, (tuple, list)) and len(batch) == 2 else batch
+        if isinstance(batch, (tuple, list)) and len(batch) == 2:
+            x = batch[0]
+        elif isinstance(batch, dict):
+            x = batch.get("input_ids", batch)
+        else:
+            x = batch
         for layer in layers:
             rng, sub = jax.random.split(rng)
             p = layer.init(sub, x)
@@ -171,6 +176,8 @@ class PipelineModule(DSModule):
         layers = self.build_layers()
         if isinstance(batch, (tuple, list)) and len(batch) == 2:
             x, labels = batch
+        elif isinstance(batch, dict):
+            x, labels = batch.get("input_ids", batch), batch.get("labels")
         else:
             x, labels = batch, None
         for p, layer in zip(params, layers):

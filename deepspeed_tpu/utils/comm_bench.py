@@ -1,0 +1,95 @@
+"""Collective benchmark sweep (``ds_bench`` CLI).
+
+Counterpart of the reference's ``bin/ds_bench`` → comm benchmark: times the
+core collectives (all_reduce / all_gather / reduce_scatter / all_to_all)
+over the live device mesh across a size sweep and prints achieved bus
+bandwidth (same algbw/busbw accounting as
+``deepspeed/utils/comms_logging.py get_bw``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+
+def _bw_gb(op: str, size_bytes: int, seconds: float, n: int) -> float:
+    """Bus bandwidth in GB/s (ring-algorithm accounting, comms_logging.get_bw)."""
+    if seconds == 0:
+        return 0.0
+    algbw = size_bytes / seconds
+    if op in ("all_reduce",):
+        busbw = algbw * (2 * (n - 1) / n)
+    elif op in ("all_gather", "reduce_scatter", "all_to_all"):
+        busbw = algbw * ((n - 1) / n)
+    else:
+        busbw = algbw
+    return busbw / 1e9
+
+
+def run_sweep(sizes_mb, trials: int = 5, warmups: int = 2):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()
+    n = len(devs)
+    mesh = Mesh(np.asarray(devs), ("x",))
+    results = []
+
+    ops = {
+        "all_reduce": lambda x: jax.lax.psum(x, "x"),
+        "all_gather": lambda x: jax.lax.all_gather(x, "x"),
+        "reduce_scatter": lambda x: jax.lax.psum_scatter(x, "x", tiled=True),
+        "all_to_all": lambda x: jax.lax.all_to_all(
+            x.reshape(n, -1), "x", split_axis=0, concat_axis=0
+        ),
+    }
+    for size_mb in sizes_mb:
+        elems = int(size_mb * 1e6 / 4)
+        elems = max(elems - elems % (n * n), n * n)
+        for name, op in ops.items():
+            fn = jax.jit(
+                jax.shard_map(
+                    op,
+                    mesh=mesh,
+                    in_specs=P("x"),
+                    out_specs=P("x") if name != "all_reduce" else P(None),
+                    check_vma=False,
+                )
+            )
+            x = jax.device_put(
+                jnp.ones((elems,), jnp.float32), NamedSharding(mesh, P("x"))
+            )
+            for _ in range(warmups):
+                fn(x).block_until_ready()
+            t0 = time.perf_counter()
+            for _ in range(trials):
+                out = fn(x)
+            out.block_until_ready()
+            dt = (time.perf_counter() - t0) / trials
+            results.append(
+                {
+                    "op": name,
+                    "size_mb": size_mb,
+                    "time_ms": dt * 1e3,
+                    "busbw_gb_s": _bw_gb(name, elems * 4, dt, n),
+                }
+            )
+    return results
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="deepspeed_tpu collective benchmark")
+    parser.add_argument("--sizes-mb", type=float, nargs="+", default=[1, 16, 64])
+    parser.add_argument("--trials", type=int, default=5)
+    args = parser.parse_args(argv)
+    results = run_sweep(args.sizes_mb, trials=args.trials)
+    print(f"{'op':16s} {'size(MB)':>9s} {'time(ms)':>10s} {'busbw(GB/s)':>12s}")
+    for r in results:
+        print(
+            f"{r['op']:16s} {r['size_mb']:9.1f} {r['time_ms']:10.3f} {r['busbw_gb_s']:12.2f}"
+        )
+    return 0

@@ -1,0 +1,117 @@
+"""Elasticity tests (reference: ``tests/unit/elasticity/test_elastic.py``)."""
+
+from __future__ import annotations
+
+import pytest
+
+from deepspeed_tpu.elasticity import (
+    compute_elastic_config,
+    elasticity_enabled,
+    get_compatible_gpus_v01,
+)
+from deepspeed_tpu.elasticity.config import ElasticityConfigError
+from deepspeed_tpu.elasticity.elasticity import ElasticityIncompatibleWorldSize
+
+
+BASE_CONFIG = {
+    "elasticity": {
+        "enabled": True,
+        "max_train_batch_size": 10000,
+        "micro_batch_sizes": [8, 12, 16, 17],
+        "min_gpus": 32,
+        "max_gpus": 1500,
+        "min_time": 20,
+        "version": 0.1,
+    }
+}
+
+
+class TestV01:
+    def test_basic(self):
+        final_batch, valid_gpus = compute_elastic_config(BASE_CONFIG, "0.1.0")
+        assert final_batch <= 10000
+        assert valid_gpus
+        # every valid chip count divides the batch with some micro size
+        micro = BASE_CONFIG["elasticity"]["micro_batch_sizes"]
+        for g in valid_gpus:
+            assert any(final_batch % (m * g) == 0 for m in micro)
+            assert 32 <= g <= 1500
+
+    def test_compatible_world_size(self):
+        final_batch, valid_gpus = compute_elastic_config(BASE_CONFIG, "0.1.0")
+        ws = valid_gpus[0]
+        fb, vg, mb = compute_elastic_config(BASE_CONFIG, "0.1.0", world_size=ws)
+        assert fb == final_batch
+        assert mb in BASE_CONFIG["elasticity"]["micro_batch_sizes"]
+        assert fb % (mb * ws) == 0
+
+    def test_incompatible_world_size(self):
+        _, valid_gpus = compute_elastic_config(BASE_CONFIG, "0.1.0")
+        bad = max(valid_gpus) + 1
+        while bad in valid_gpus:
+            bad += 1
+        with pytest.raises(ElasticityIncompatibleWorldSize):
+            compute_elastic_config(BASE_CONFIG, "0.1.0", world_size=bad)
+
+    def test_disabled_raises(self):
+        cfg = {"elasticity": dict(BASE_CONFIG["elasticity"], enabled=False)}
+        with pytest.raises(ElasticityConfigError):
+            compute_elastic_config(cfg, "0.1.0")
+
+    def test_missing_section_raises(self):
+        with pytest.raises(ElasticityConfigError):
+            compute_elastic_config({}, "0.1.0")
+
+    def test_enabled_probe(self):
+        assert elasticity_enabled(BASE_CONFIG)
+        assert not elasticity_enabled({})
+
+    def test_invalid_micro_batches(self):
+        cfg = {"elasticity": dict(BASE_CONFIG["elasticity"], micro_batch_sizes=[8, -1])}
+        with pytest.raises(ElasticityConfigError):
+            compute_elastic_config(cfg, "0.1.0")
+
+
+class TestV01Math:
+    def test_prefer_larger(self):
+        fb_large, _ = get_compatible_gpus_v01([2, 4], 128, prefer_larger=True)
+        assert fb_large <= 128
+        assert fb_large > 0
+
+    def test_valid_gpu_divisibility(self):
+        fb, gpus = get_compatible_gpus_v01([2, 3], 60, min_gpus=1, max_gpus=100)
+        for g in gpus:
+            assert fb % (2 * g) == 0 or fb % (3 * g) == 0
+
+
+class TestV02:
+    def test_model_parallel(self):
+        cfg = {
+            "elasticity": {
+                "enabled": True,
+                "max_train_batch_size": 2048,
+                "micro_batch_sizes": [2, 4],
+                "min_gpus": 1,
+                "max_gpus": 1024,
+                "version": 0.2,
+                "model_parallel_size": 4,
+                "num_gpus_per_node": 4,
+            }
+        }
+        fb, valid_gpus, mb = compute_elastic_config(cfg, "0.1.0", world_size=0, return_microbatch=True)
+        assert fb % 4 == 0  # multiple of mp size
+        for g in valid_gpus:
+            assert g % 4 == 0
+
+    def test_v01_rejects_model_parallel(self):
+        cfg = {
+            "elasticity": {
+                "enabled": True,
+                "max_train_batch_size": 2048,
+                "micro_batch_sizes": [2, 4],
+                "version": 0.1,
+                "model_parallel_size": 4,
+            }
+        }
+        with pytest.raises(ElasticityConfigError):
+            compute_elastic_config(cfg, "0.1.0")

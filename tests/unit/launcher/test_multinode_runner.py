@@ -1,0 +1,81 @@
+"""Multinode runner command construction (reference:
+``tests/unit/launcher/test_multinode_runner.py``)."""
+
+from __future__ import annotations
+
+from copy import deepcopy
+
+import pytest
+
+from deepspeed_tpu.launcher.multinode_runner import (
+    MPICHRunner,
+    OpenMPIRunner,
+    PDSHRunner,
+    SlurmRunner,
+)
+from deepspeed_tpu.launcher.runner import parse_args
+
+
+@pytest.fixture
+def runner_info():
+    hosts = {"worker-0": 4, "worker-1": 4}
+    world_info = "SGVsbG8gV29ybGQ="
+    env = {"PATH": "/usr/bin"}
+    args = parse_args(["test_launcher.py", "--launcher_arg", "1"])
+    return env, hosts, world_info, args
+
+
+def test_pdsh_runner(runner_info):
+    env, resource_pool, world_info, args = runner_info
+    runner = PDSHRunner(args, world_info)
+    cmd = runner.get_cmd(env, {"worker-0": [0, 1], "worker-1": [0, 1]})
+    assert cmd[0] == "pdsh"
+    assert "-w" in cmd
+    assert "worker-0,worker-1" in cmd
+    assert "deepspeed_tpu.launcher.launch" in cmd
+    assert env["PDSH_RCMD_TYPE"] == "ssh"
+    assert cmd[-3:] == ["test_launcher.py", "--launcher_arg", "1"]
+
+
+def test_pdsh_runner_exports(runner_info):
+    env, resource_pool, world_info, args = runner_info
+    runner = PDSHRunner(args, world_info)
+    runner.add_export("JAX_PLATFORMS", "tpu")
+    cmd = runner.get_cmd(env, {"worker-0": [0]})
+    joined = " ".join(cmd)
+    assert "export JAX_PLATFORMS=tpu;" in joined
+
+
+def test_openmpi_runner(runner_info):
+    env, resource_pool, world_info, args = runner_info
+    runner = OpenMPIRunner(args, world_info, resource_pool)
+    cmd = runner.get_cmd(env, resource_pool)
+    assert cmd[0] == "mpirun"
+    assert "-n" in cmd
+    assert cmd[cmd.index("-n") + 1] == "2"  # one proc per host
+    assert "test_launcher.py" in cmd
+
+
+def test_openmpi_rejects_include(runner_info):
+    env, resource_pool, world_info, _ = runner_info
+    args = parse_args(["-i", "worker-0", "test_launcher.py"])
+    runner = OpenMPIRunner(args, world_info, resource_pool)
+    with pytest.raises(ValueError):
+        runner.validate_args()
+
+
+def test_mpich_runner(runner_info):
+    env, resource_pool, world_info, args = runner_info
+    runner = MPICHRunner(args, world_info, resource_pool)
+    cmd = runner.get_cmd(env, resource_pool)
+    assert cmd[0] == "mpirun"
+    assert "-ppn" in cmd
+    assert cmd[cmd.index("-ppn") + 1] == "1"
+
+
+def test_slurm_runner(runner_info):
+    env, resource_pool, world_info, args = runner_info
+    runner = SlurmRunner(args, world_info, resource_pool)
+    cmd = runner.get_cmd(env, resource_pool)
+    assert cmd[0] == "srun"
+    assert "--ntasks-per-node=1" in cmd

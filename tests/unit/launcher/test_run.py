@@ -1,0 +1,105 @@
+"""Launcher parsing tests (reference: ``tests/unit/launcher/test_run.py``)."""
+
+from __future__ import annotations
+
+import base64
+import json
+
+import pytest
+
+from deepspeed_tpu.launcher.launch import decode_world_info, encode_world_info
+from deepspeed_tpu.launcher.runner import (
+    fetch_hostfile,
+    parse_args,
+    parse_resource_filter,
+)
+
+
+def _hostfile(tmp_path, text):
+    p = tmp_path / "hostfile"
+    p.write_text(text)
+    return str(p)
+
+
+class TestHostfile:
+    def test_parse(self, tmp_path):
+        path = _hostfile(tmp_path, "worker-0 slots=4\nworker-1 slots=8\n")
+        pool = fetch_hostfile(path)
+        assert pool == {"worker-0": 4, "worker-1": 8}
+
+    def test_comments_and_blanks(self, tmp_path):
+        path = _hostfile(tmp_path, "# comment\n\nworker-0 slots=2\n")
+        assert fetch_hostfile(path) == {"worker-0": 2}
+
+    def test_missing_file(self):
+        assert fetch_hostfile("/nonexistent/hostfile") == {}
+
+    def test_malformed_raises(self, tmp_path):
+        path = _hostfile(tmp_path, "worker-0 slots=banana\n")
+        with pytest.raises(ValueError):
+            fetch_hostfile(path)
+
+    def test_duplicate_raises(self, tmp_path):
+        path = _hostfile(tmp_path, "w slots=1\nw slots=2\n")
+        with pytest.raises(ValueError):
+            fetch_hostfile(path)
+
+
+class TestResourceFilter:
+    POOL = {"worker-0": 4, "worker-1": 4}
+
+    def test_no_filter(self):
+        out = parse_resource_filter(self.POOL)
+        assert out == {"worker-0": [0, 1, 2, 3], "worker-1": [0, 1, 2, 3]}
+
+    def test_include_host(self):
+        out = parse_resource_filter(self.POOL, include_str="worker-0")
+        assert out == {"worker-0": [0, 1, 2, 3]}
+
+    def test_include_slots(self):
+        out = parse_resource_filter(self.POOL, include_str="worker-1:0,2")
+        assert out == {"worker-1": [0, 2]}
+
+    def test_exclude_host(self):
+        out = parse_resource_filter(self.POOL, exclude_str="worker-1")
+        assert out == {"worker-0": [0, 1, 2, 3]}
+
+    def test_exclude_slots(self):
+        out = parse_resource_filter(self.POOL, exclude_str="worker-0:1,3")
+        assert out["worker-0"] == [0, 2]
+
+    def test_both_raises(self):
+        with pytest.raises(ValueError):
+            parse_resource_filter(self.POOL, include_str="worker-0", exclude_str="worker-1")
+
+    def test_unknown_host_raises(self):
+        with pytest.raises(ValueError):
+            parse_resource_filter(self.POOL, include_str="worker-9")
+
+
+class TestWorldInfo:
+    def test_roundtrip(self):
+        info = {"worker-0": [0, 1], "worker-1": [0, 1]}
+        enc = encode_world_info(info)
+        assert decode_world_info(enc) == info
+        # stable b64 json, inspectable by hand
+        assert json.loads(base64.urlsafe_b64decode(enc)) == info
+
+    def test_none(self):
+        assert decode_world_info("None") == {}
+
+
+class TestArgs:
+    def test_defaults(self):
+        args = parse_args(["train.py"])
+        assert args.launcher == "pdsh"
+        assert args.user_script == "train.py"
+        assert args.master_port == 29500
+
+    def test_user_args_passthrough(self):
+        args = parse_args(["train.py", "--lr", "0.1", "--deepspeed"])
+        assert args.user_args == ["--lr", "0.1", "--deepspeed"]
+
+    def test_include(self):
+        args = parse_args(["-i", "worker-0:0", "train.py"])
+        assert args.include == "worker-0:0"

@@ -173,7 +173,7 @@ def _train(config, blocks, steps=3, seed=0):
 class TestPipeTraining:
     def test_pipe4_matches_dense(self, eight_devices):  # noqa: ARG002
         dense_cfg = dict(CONFIG, mesh={"data": 8})
-        dense = _train_dense_reference(dense_cfg, blocks=4, steps=3)
+        dense = _train(dense_cfg, blocks=4, steps=3)
         pipe_cfg = dict(CONFIG, mesh={"pipe": 4, "data": 2})
         pipe = _train(pipe_cfg, blocks=4, steps=3)
         np.testing.assert_allclose(pipe, dense, rtol=2e-4, atol=2e-5)
@@ -204,28 +204,3 @@ class TestPipeTraining:
         x, y = _data(n=16)
         loss = engine.eval_batch(batch=(x, y))
         assert np.isfinite(float(jax.device_get(loss)))
-
-
-def _train_dense_reference(config, blocks, steps, seed=0):
-    """Same network trained by the dense engine (pipe=1 path) — the parity
-    baseline. Uses the same per-step full batches split into gas microbatches
-    to match the pipeline's data order."""
-    mesh_mod.reset_topology()
-    pm = PipelineModule(_specs(blocks=blocks), loss_fn=_mse)
-    engine, _, _, _ = ds.initialize(model=pm, config=config, dist_init_required=False)
-    gas = config["gradient_accumulation_steps"]
-    losses = []
-    rs = np.random.RandomState(seed)
-    for step in range(steps):
-        x, y = _step_data(rs)
-        n = x.shape[0]
-        mb_losses = []
-        for g in range(gas):
-            lo = g * (n // gas)
-            hi = lo + n // gas
-            loss = engine.forward((x[lo:hi], y[lo:hi]))
-            engine.backward(loss)
-            engine.step()
-            mb_losses.append(float(jax.device_get(loss)))
-        losses.append(float(np.mean(mb_losses)))
-    return losses
