@@ -1,0 +1,106 @@
+"""Native op JIT build system.
+
+Counterpart of the reference's ``op_builder/builder.py`` ``OpBuilder`` JIT
+path (ninja ``load()``): each native op is one C++ translation unit under
+``csrc/``, compiled lazily on first use with the host toolchain into a
+shared library cached by source hash, and loaded via ctypes. The AOT path
+(reference ``DS_BUILD_*`` env flags) is ``DS_BUILD_NATIVE=1`` at setup time
+(see ``setup.py``), which just calls :func:`build_all` eagerly.
+
+ctypes instead of pybind11 (not in the image): every exported symbol is
+``extern "C"`` with scalar/pointer args, and the python wrappers pass numpy
+buffers by pointer.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+from pathlib import Path
+from typing import Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+_REPO_ROOT = Path(__file__).resolve().parents[3]
+_CSRC = _REPO_ROOT / "csrc"
+_CACHE_DIR = Path(
+    os.environ.get(
+        "DS_NATIVE_CACHE", os.path.join(os.path.expanduser("~"), ".cache", "deepspeed_tpu")
+    )
+)
+
+_OPS = {
+    "aio": ["aio/deepspeed_aio.cpp"],
+    "cpu_adam": ["adam/cpu_adam.cpp"],
+    "cpu_adagrad": ["adagrad/cpu_adagrad.cpp"],
+}
+
+_BASE_FLAGS = ["-O3", "-std=c++17", "-shared", "-fPIC", "-pthread"]
+_loaded: dict = {}
+
+
+def _march_flags() -> list:
+    """-march=native unless the toolchain rejects it (non-x86 hosts)."""
+    probe = subprocess.run(
+        ["g++", "-march=native", "-E", "-x", "c++", "/dev/null"],
+        capture_output=True,
+    )
+    return ["-march=native"] if probe.returncode == 0 else []
+
+
+def _source_hash(sources) -> str:
+    h = hashlib.sha256()
+    for rel in sources:
+        h.update((_CSRC / rel).read_bytes())
+    return h.hexdigest()[:16]
+
+
+def build_op(name: str, verbose: bool = False) -> Optional[Path]:
+    """Compile one op's shared library (cached); returns the .so path or
+    None when the toolchain is unavailable."""
+    sources = _OPS[name]
+    try:
+        tag = _source_hash(sources)
+    except FileNotFoundError:
+        logger.warning(f"native op {name}: sources missing under {_CSRC}")
+        return None
+    out = _CACHE_DIR / f"lib_{name}_{tag}.so"
+    if out.exists():
+        return out
+    _CACHE_DIR.mkdir(parents=True, exist_ok=True)
+    cmd = (
+        ["g++"]
+        + _BASE_FLAGS
+        + _march_flags()
+        + [str(_CSRC / rel) for rel in sources]
+        + ["-o", str(out)]
+    )
+    if verbose:
+        logger.info(f"building native op {name}: {' '.join(cmd)}")
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        logger.warning(f"native op {name} build failed:\n{proc.stderr}")
+        return None
+    return out
+
+
+def load_op(name: str) -> Optional[ctypes.CDLL]:
+    """Build (if needed) and dlopen an op; memoized per process."""
+    if name in _loaded:
+        return _loaded[name]
+    path = build_op(name)
+    lib = None
+    if path is not None:
+        try:
+            lib = ctypes.CDLL(str(path))
+        except OSError as e:
+            logger.warning(f"native op {name}: dlopen failed: {e}")
+    _loaded[name] = lib
+    return lib
+
+
+def build_all(verbose: bool = True) -> dict:
+    """AOT build of every native op (reference DS_BUILD_* semantics)."""
+    return {name: build_op(name, verbose=verbose) for name in _OPS}

@@ -177,6 +177,7 @@ class DeepSpeedEngine:
         self._overflow = False
         self._pending_model_parameters = model_parameters
 
+        self._host_offload = None
         self.partitioner: Optional[ZeroPartitioner] = None
         self._jit_fwd_bwd = None
         self._jit_eval = None
@@ -346,24 +347,63 @@ class DeepSpeedEngine:
             self._params = jax.jit(lambda t: t, out_shardings=param_shardings)(master)
             self._master = self._params
 
-        opt_specs = self.optimizer.state_specs(self._master_specs)
-        opt_shardings = jax.tree_util.tree_map(
-            lambda s: NamedSharding(self.mesh, s),
-            opt_specs,
-            is_leaf=lambda x: isinstance(x, PartitionSpec),
-        )
-        self._opt_state = jax.jit(self.optimizer.init_state, out_shardings=opt_shardings)(self._master)
-        self._opt_shardings = opt_shardings
+        if self._offload_enabled():
+            # ZeRO-Offload/Infinity: fp32 master + moments leave the chip —
+            # host DRAM (device=cpu) or local SSD (device=nvme) via the
+            # native AVX Adam + aio swapper (runtime/zero/offload_states.py)
+            from deepspeed_tpu.runtime.zero.offload_states import HostOffloadAdam
+
+            opt_cfg = self._config.optimizer_config
+            opt_type = (opt_cfg.type.lower() if opt_cfg is not None and opt_cfg.type else "adam")
+            if opt_type not in (C.ADAM_OPTIMIZER, C.ADAMW_OPTIMIZER, C.FUSED_ADAM_OPTIMIZER, C.CPU_ADAM_OPTIMIZER):
+                raise ValueError(
+                    f"offload_optimizer runs the host Adam/AdamW (DeepSpeedCPUAdam "
+                    f"analog); configured optimizer {opt_type!r} is not supported with "
+                    "offload — use an adam variant or disable offload"
+                )
+            if self.client_optimizer is not None:
+                raise ValueError(
+                    "offload_optimizer is incompatible with a client optimizer: the "
+                    "host offload path owns the update rule (Adam/AdamW)"
+                )
+            params_cfg = dict(opt_cfg.params) if opt_cfg is not None else {}
+            self._host_offload = HostOffloadAdam(
+                master,
+                self.compute_dtype,
+                self._config.zero_config.offload_optimizer,
+                aio_param_dict=self._config._param_dict,
+                betas=tuple(params_cfg.get("betas", (0.9, 0.999))),
+                eps=params_cfg.get("eps", 1e-8),
+                weight_decay=params_cfg.get("weight_decay", 0.0),
+                adamw_mode=params_cfg.get("adam_w_mode", True),
+            )
+            self._host_offload.set_param_dtypes(
+                [l.dtype for l in jax.tree_util.tree_leaves(self._params)]
+            )
+            # free the device-side master: the host copy is authoritative now
+            self._master = None
+            self._opt_state = None
+            self._opt_shardings = None
+        else:
+            self._host_offload = None
+            opt_specs = self.optimizer.state_specs(self._master_specs)
+            opt_shardings = jax.tree_util.tree_map(
+                lambda s: NamedSharding(self.mesh, s),
+                opt_specs,
+                is_leaf=lambda x: isinstance(x, PartitionSpec),
+            )
+            self._opt_state = jax.jit(self.optimizer.init_state, out_shardings=opt_shardings)(self._master)
+            self._opt_shardings = opt_shardings
 
         zeros32 = jax.jit(
             lambda t: jax.tree_util.tree_map(lambda x: jnp.zeros(x.shape, jnp.float32), t),
             out_shardings=grad_shardings,
         )
-        self._grad_acc = zeros32(self._master)
+        self._grad_acc = zeros32(self._params)
         self._scale_state = jax.device_put(self.loss_scaler.init_state())
         self._build_jitted_fns()
         self._initialized = True
-        n_params = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(self._master))
+        n_params = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(self._params))
         log_dist(f"Initialized model state: {n_params:,} parameters", ranks=[0])
 
     def _batch_pspec(self, batch) -> Any:
@@ -480,6 +520,28 @@ class DeepSpeedEngine:
             new_scale_state = scaler.update(scale_state, overflow)
             return new_params, new_master, new_opt, zeroed, new_scale_state, grad_norm, overflow
 
+        if self._host_offload is not None:
+            # offload path: the fused device step is replaced by (tiny jitted
+            # grad stats) + host AVX Adam; see _take_model_step
+            def grad_stats(grad_acc, scale):
+                inv = 1.0 / (scale * gas)
+                sq = sum(jnp.sum(jnp.square(g)) for g in jax.tree_util.tree_leaves(grad_acc))
+                overflow = (
+                    has_inf_or_nan(grad_acc) if fp16 else jnp.zeros((), jnp.bool_)
+                )
+                return jnp.sqrt(sq) * inv, overflow
+
+            self._jit_grad_stats = jax.jit(grad_stats)
+            self._jit_zero_grads = jax.jit(
+                lambda t: jax.tree_util.tree_map(jnp.zeros_like, t),
+                donate_argnums=(0,),
+            )
+            self._jit_reshard_params = jax.jit(
+                lambda t: t, out_shardings=self._param_shardings
+            )
+            self._jit_step = None
+            return
+
         if mixed:
             self._jit_step = jax.jit(
                 step_fn,
@@ -562,8 +624,55 @@ class DeepSpeedEngine:
         self.timers(STEP_GLOBAL_TIMER).stop(sync=False)
         self.tput_timer.stop(global_step=boundary)
 
+    def _offload_enabled(self) -> bool:
+        off = self._config.zero_config.offload_optimizer
+        requested = off is not None and str(off.device) not in ("none", "OffloadDeviceEnum.none")
+        if requested and self._config.zero_optimization_stage < 1:
+            raise ValueError(
+                "offload_optimizer requires ZeRO stage >= 1 (stage 0 keeps full "
+                "optimizer state on device; set zero_optimization.stage)"
+            )
+        return requested
+
+    def _take_offload_step(self, lr: float) -> None:
+        """Host-optimizer step (ZeRO-Offload): device computes grad stats,
+        the native AVX Adam updates host partitions, params return to chip."""
+        scale = self._scale_state.scale
+        grad_norm, overflow_flag = self._jit_grad_stats(self._grad_acc, scale)
+        self._last_grad_norm = grad_norm
+        overflow = bool(jax.device_get(overflow_flag)) if self._config.fp16_enabled else False
+        if not overflow:
+            clip = self._config.gradient_clipping
+            norm = float(jax.device_get(grad_norm))
+            clip_coef = min(1.0, clip / (norm + 1e-6)) if clip > 0 else 1.0
+            inv = 1.0 / (float(jax.device_get(scale)) * self._gas_divisor)
+            grad_leaves = jax.tree_util.tree_leaves(self._grad_acc)
+            new_leaves = self._host_offload.step(grad_leaves, lr, inv, clip_coef)
+            new_params = self._host_offload.unflatten(new_leaves)
+            # restore the engine's param shardings (master shards may be
+            # finer, e.g. persistent small params replicated under stage 3)
+            self._params = self._jit_reshard_params(new_params)
+        self._grad_acc = self._jit_zero_grads(self._grad_acc)
+        self._scale_state = self.loss_scaler.update(self._scale_state, overflow_flag)
+        self._overflow = overflow
+
     def _take_model_step(self) -> None:
         lr = self.optimizer.param_groups[0]["lr"]
+        if self._host_offload is not None:
+            self._take_offload_step(lr)
+            self.global_steps += 1
+            if self._overflow:
+                self.skipped_steps += 1
+                log_dist(
+                    f"[deepspeed_tpu] OVERFLOW! skipping step, new loss scale: {self.loss_scale}",
+                    ranks=[0],
+                )
+            if self.lr_scheduler is not None and not self._overflow:
+                self.lr_scheduler.step()
+            self._overflow = False
+            if self.monitor is not None and self.global_steps % self._config.steps_per_print == 0:
+                self._write_monitor()
+            return
         if self.mixed_precision:
             (
                 self._params,
@@ -667,10 +776,19 @@ class DeepSpeedEngine:
         self._validate_checkpoint_tag(tag)
         path = self._ckpt_dir(save_dir, tag)
         self.checkpoint_engine.create(tag)
+        if self._host_offload is not None:
+            # the fp32 master lives inside the host-offload state dict; a
+            # second device-side copy would double checkpoint size AND
+            # materialize fp32 master in HBM (the memory offload avoids)
+            master = None
+            optimizer_state = {"host_offload": self._host_offload.state_dict()}
+        else:
+            master = self._master if self.mixed_precision else None
+            optimizer_state = _namedtuple_to_dict(self._opt_state)
         state = {
             "module": self._params,
-            "master": self._master if self.mixed_precision else None,
-            "optimizer": _namedtuple_to_dict(self._opt_state),
+            "master": master,
+            "optimizer": optimizer_state,
             "loss_scaler": _namedtuple_to_dict(self._scale_state),
             "lr_scheduler": self.lr_scheduler.state_dict() if self.lr_scheduler is not None else None,
             "global_steps": self.global_steps,
@@ -727,15 +845,38 @@ class DeepSpeedEngine:
             )
         put_p = jax.jit(lambda t: t, out_shardings=self._param_shardings)
         self._params = put_p(jax.tree_util.tree_map(jnp.asarray, state["module"]))
-        if self.mixed_precision and state.get("master") is not None:
+        if self._host_offload is not None:
+            opt_state = state.get("optimizer")
+            if isinstance(opt_state, dict) and "host_offload" in opt_state:
+                if not (load_optimizer_states and not load_module_only):
+                    # module-only load must still refresh the host master, or
+                    # the next step clobbers the loaded weights with the
+                    # stale init-time master
+                    self._host_offload.load_master_only(opt_state["host_offload"])
+            elif state.get("master") is not None:
+                # checkpoint from a non-offload run: adopt its master
+                leaves = jax.tree_util.tree_leaves(
+                    jax.tree_util.tree_map(jnp.asarray, state["master"])
+                )
+                self._host_offload.set_master_leaves(leaves)
+            else:
+                # fp32 non-offload checkpoint: module weights ARE the master
+                leaves = jax.tree_util.tree_leaves(
+                    jax.tree_util.tree_map(jnp.asarray, state["module"])
+                )
+                self._host_offload.set_master_leaves(leaves)
+        elif self.mixed_precision and state.get("master") is not None:
             put_m = jax.jit(lambda t: t, out_shardings=self._master_shardings)
             self._master = put_m(jax.tree_util.tree_map(jnp.asarray, state["master"]))
         elif not self.mixed_precision:
             self._master = self._params
         if load_optimizer_states and not load_module_only and state.get("optimizer") is not None:
-            opt = _dict_to_namedtuple(state["optimizer"], type(self._opt_state))
-            put_o = jax.jit(lambda t: t, out_shardings=self._opt_shardings)
-            self._opt_state = put_o(jax.tree_util.tree_map(jnp.asarray, opt))
+            if self._host_offload is not None:
+                self._host_offload.load_state_dict(state["optimizer"]["host_offload"])
+            else:
+                opt = _dict_to_namedtuple(state["optimizer"], type(self._opt_state))
+                put_o = jax.jit(lambda t: t, out_shardings=self._opt_shardings)
+                self._opt_state = put_o(jax.tree_util.tree_map(jnp.asarray, opt))
         if state.get("loss_scaler") is not None:
             self._scale_state = jax.device_put(
                 _dict_to_namedtuple(state["loss_scaler"], LossScaleState)
@@ -757,12 +898,15 @@ class DeepSpeedEngine:
         return self._params
 
     def get_master_params(self):
+        if self._host_offload is not None:
+            return self._host_offload.unflatten(self._host_offload.master_leaves())
         return self._master
 
     def num_parameters(self) -> int:
         if not self._initialized:
             return 0
-        return sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(self._master))
+        tree = self._params if self._master is None else self._master
+        return sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(tree))
 
 
 def _namedtuple_to_dict(nt):

@@ -1,0 +1,13 @@
+"""Tensor swapping to disk (ZeRO-Infinity).
+
+Counterpart of the reference's ``deepspeed/runtime/swap_tensor/`` built on
+the native aio library (``csrc/aio/deepspeed_aio.cpp``).
+"""
+
+from deepspeed_tpu.runtime.swap_tensor.aio_config import AioConfig, get_aio_config
+from deepspeed_tpu.runtime.swap_tensor.utils import SwapBuffer, SwapBufferManager, SwapBufferPool
+from deepspeed_tpu.runtime.swap_tensor.async_swapper import AsyncTensorSwapper
+from deepspeed_tpu.runtime.swap_tensor.optimizer_utils import OptimizerSwapper
+from deepspeed_tpu.runtime.swap_tensor.partitioned_optimizer_swapper import (
+    PartitionedOptimizerSwapper,
+)

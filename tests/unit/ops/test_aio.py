@@ -1,0 +1,135 @@
+"""Native async-IO op tests (reference: ``tests/unit/ops/aio/test_aio.py``)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.aio import AsyncIOBuilder
+
+pytestmark = pytest.mark.skipif(
+    not AsyncIOBuilder().is_compatible(), reason="native aio unavailable"
+)
+
+
+@pytest.fixture
+def handle():
+    from deepspeed_tpu.ops.aio import AsyncIOHandle
+
+    return AsyncIOHandle(block_size=1 << 16, queue_depth=4, thread_count=2)
+
+
+class TestAio:
+    @pytest.mark.parametrize("numel", [255, 1 << 12, (1 << 18) + 31])
+    def test_write_read_roundtrip(self, handle, tmp_path, numel):
+        buf = np.random.RandomState(0).randn(numel).astype(np.float32)
+        path = str(tmp_path / "t.swp")
+        assert handle.sync_pwrite(buf, path) == buf.nbytes
+        out = np.empty_like(buf)
+        assert handle.sync_pread(out, path) == buf.nbytes
+        np.testing.assert_array_equal(buf, out)
+
+    def test_async_overlap(self, handle, tmp_path):
+        bufs = [np.full(1 << 14, i, np.float32) for i in range(8)]
+        for i, b in enumerate(bufs):
+            handle.async_pwrite(b, str(tmp_path / f"{i}.swp"))
+        assert handle.wait() == 8
+        outs = [np.empty_like(b) for b in bufs]
+        for i, o in enumerate(outs):
+            handle.async_pread(o, str(tmp_path / f"{i}.swp"))
+        handle.wait()
+        for b, o in zip(bufs, outs):
+            np.testing.assert_array_equal(b, o)
+
+    def test_read_missing_file_raises(self, handle, tmp_path):
+        out = np.empty(16, np.float32)
+        with pytest.raises(IOError):
+            handle.async_pread(out, str(tmp_path / "missing.swp"))
+            handle.wait()
+
+
+class TestSwapBuffers:
+    def test_buffer_pack_unpack(self):
+        from deepspeed_tpu.runtime.swap_tensor.utils import SwapBuffer
+
+        buf = SwapBuffer(np.zeros(1024, np.float32))
+        t1 = np.arange(100, dtype=np.float32)
+        swap, compute = buf.insert_tensor(t1, "/tmp/a.swp", 128)
+        assert swap.size == 128 and compute.size == 100
+        np.testing.assert_array_equal(compute, t1)
+        assert buf.get_swap_paths() == ["/tmp/a.swp"]
+        assert not buf.has_space(1024 - 128 + 1)
+
+    def test_manager_alloc_free(self):
+        from deepspeed_tpu.runtime.swap_tensor.utils import SwapBufferManager
+
+        mgr = SwapBufferManager(num_elems=256, count=4)
+        bufs = mgr.allocate(num_elems=200, count=2)
+        assert len(bufs) == 2
+        assert mgr.allocate(200, 3) is None  # only 2 free left
+        mgr.free(bufs)
+        assert mgr.allocate(200, 4) is not None
+
+    def test_async_swapper(self, tmp_path):
+        from deepspeed_tpu.ops.aio import AsyncIOHandle
+        from deepspeed_tpu.runtime.swap_tensor.async_swapper import AsyncTensorSwapper
+
+        h = AsyncIOHandle(block_size=1 << 16, thread_count=2)
+        swapper = AsyncTensorSwapper(h, numel_alignment=256)
+        swapper.add_buffers([np.zeros(1 << 12, np.float32) for _ in range(2)])
+        tensors = [np.full(1000, i, np.float32) for i in range(6)]
+        paths = [str(tmp_path / f"s{i}.swp") for i in range(6)]
+        swapper.swap_out_tensors(tensors, paths)
+        swapper.release_buffers()
+        for i, p in enumerate(paths):
+            out = np.empty(1024, np.float32)  # aligned numel
+            h.async_pread(out, p)
+            h.wait()
+            np.testing.assert_array_equal(out[:1000], tensors[i])
+
+
+class TestNativeAdam:
+    def test_adam_vs_numpy(self):
+        from deepspeed_tpu.ops.adam.cpu_adam_native import NativeCPUAdam, native_adam_available
+
+        if not native_adam_available():
+            pytest.skip("no native adam")
+        rs = np.random.RandomState(1)
+        n = 10007
+        p = rs.randn(n).astype(np.float32)
+        g = rs.randn(n).astype(np.float32)
+        m = np.zeros(n, np.float32)
+        v = np.zeros(n, np.float32)
+        p_ref, m_ref, v_ref = p.copy(), m.copy(), v.copy()
+        opt = NativeCPUAdam(lr=1e-3, weight_decay=0.01, adamw_mode=True)
+        for step in range(1, 5):
+            opt.step(p, g, m, v, step=step)
+            m_ref = 0.9 * m_ref + 0.1 * g
+            v_ref = 0.999 * v_ref + 0.001 * g * g
+            bc1, bc2 = 1 - 0.9**step, 1 - 0.999**step
+            # torch-AdamW: decoupled decay lr*wd*p, unscaled by bias correction
+            p_ref = p_ref - 1e-3 * 0.01 * p_ref
+            p_ref = p_ref - 1e-3 / bc1 * (m_ref / (np.sqrt(v_ref) / np.sqrt(bc2) + 1e-8))
+        assert np.abs(p - p_ref).max() < 1e-5
+
+    def test_plain_adam_mode(self):
+        from deepspeed_tpu.ops.adam.cpu_adam_native import NativeCPUAdam, native_adam_available
+
+        if not native_adam_available():
+            pytest.skip("no native adam")
+        rs = np.random.RandomState(2)
+        n = 4096
+        p = rs.randn(n).astype(np.float32)
+        g = rs.randn(n).astype(np.float32)
+        m = np.zeros(n, np.float32)
+        v = np.zeros(n, np.float32)
+        p_ref = p.copy()
+        opt = NativeCPUAdam(lr=1e-2, weight_decay=0.1, adamw_mode=False)
+        opt.step(p, g, m, v, step=1)
+        # L2-style decay folds into the gradient
+        g_ref = g + 0.1 * p_ref
+        m_ref = 0.1 * g_ref
+        v_ref = 0.001 * g_ref * g_ref
+        upd = m_ref / (np.sqrt(v_ref) / np.sqrt(1 - 0.999) + 1e-8)
+        p_ref -= 1e-2 / (1 - 0.9) * upd
+        assert np.abs(p - p_ref).max() < 1e-5
