@@ -30,9 +30,13 @@ _DTYPES = {
 }
 
 
+def _is_hf_model(model) -> bool:
+    cfg = getattr(model, "config", None)
+    return cfg is not None and hasattr(cfg, "model_type") and hasattr(model, "state_dict")
+
+
 class InferenceEngine:
     def __init__(self, model, config: Optional[DeepSpeedInferenceConfig] = None):
-        self.module = wrap_module(model)
         self._config = config or DeepSpeedInferenceConfig()
         self.topology = get_topology()
         self.mesh = self.topology.mesh
@@ -40,20 +44,56 @@ class InferenceEngine:
         self._params = None
         self._jit_forward = None
         self._rng = jax.random.PRNGKey(0)
+        self._ds_config = None  # TransformerConfig when kernel-injected
+
+        injected = False
+        if self._config.replace_with_kernel_inject and _is_hf_model(model):
+            # reference _apply_injection_policy (inference/engine.py:371):
+            # convert the HF model to the fused TPU decoder + weights
+            from deepspeed_tpu.module_inject.replace_module import replace_transformer_layer
+
+            ds_model, params = replace_transformer_layer(
+                model=model, dtype=jnp.dtype(self.dtype).name
+            )
+            self._ds_config = ds_model.config
+            self.module = ds_model
+            if params is not None:
+                self.set_params(params)
+            injected = True
+        else:
+            self.module = wrap_module(model)
         log_dist(
-            f"InferenceEngine: dtype={self._config.dtype} tp_size={self._config.tensor_parallel.tp_size}",
+            f"InferenceEngine: dtype={self._config.dtype} "
+            f"tp_size={self._config.tensor_parallel.tp_size} kernel_inject={injected}",
             ranks=[0],
         )
 
     # --- weights --------------------------------------------------------
     def set_params(self, params: Any) -> None:
-        """Install a param pytree (cast to the inference dtype)."""
+        """Install a param pytree (cast to the inference dtype; TP-sharded
+        over the 'model' axis via AutoTP specs when tp_size > 1)."""
         cast = jax.tree_util.tree_map(
             lambda p: jnp.asarray(p).astype(self.dtype)
             if jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating)
             else jnp.asarray(p),
             params,
         )
+        if self.topology.get_model_parallel_world_size() > 1:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            tp_rules = None
+            if hasattr(self.module, "tp_partition_rules"):
+                tp_rules = self.module.tp_partition_rules(cast)
+            if tp_rules is None:
+                from deepspeed_tpu.module_inject.auto_tp import AutoTP
+
+                tp_rules = AutoTP().partition_specs(cast)
+            shardings = jax.tree_util.tree_map(
+                lambda s: NamedSharding(self.mesh, s),
+                tp_rules,
+                is_leaf=lambda x: isinstance(x, PartitionSpec),
+            )
+            cast = jax.device_put(cast, shardings)
         self._params = cast
         self._jit_forward = None
 
@@ -103,6 +143,17 @@ class InferenceEngine:
         the paged KV-cache decode path replaces the full-seq forward later."""
         from deepspeed_tpu.inference.generation import greedy_generate
 
+        if self._ds_config is not None and self._params is not None:
+            # kernel-injected path: KV-cached prefill + per-token decode
+            from deepspeed_tpu.inference.decode import generate as kv_generate
+
+            return kv_generate(
+                self._ds_config,
+                self._params,
+                input_ids,
+                max_new_tokens,
+                eos_token_id=eos_token_id,
+            )
         if self._params is None:
             self.init_params(jnp.asarray(input_ids))
         module = self.module

@@ -66,6 +66,7 @@ class TransformerConfig:
 
 def gpt2_config(size: str = "125m", **overrides) -> TransformerConfig:
     presets = {
+        "tiny": dict(hidden_size=256, num_layers=4, num_heads=8, vocab_size=1024, max_seq_len=512),
         "125m": dict(hidden_size=768, num_layers=12, num_heads=12),
         "350m": dict(hidden_size=1024, num_layers=24, num_heads=16),
         "1.3b": dict(hidden_size=2048, num_layers=24, num_heads=16),

@@ -1,0 +1,15 @@
+"""Kernel injection / AutoTP (reference: ``deepspeed/module_inject/``)."""
+
+from deepspeed_tpu.module_inject.auto_tp import (
+    AutoTP,
+    Classification,
+    ReplaceWithTensorSlicing,
+    classify_param,
+    spec_for_param,
+)
+from deepspeed_tpu.module_inject.containers import DSPolicy, policy_for, replace_policies
+from deepspeed_tpu.module_inject.replace_module import (
+    generic_injection,
+    replace_transformer_layer,
+    tp_shard_specs,
+)

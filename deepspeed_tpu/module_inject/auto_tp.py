@@ -1,0 +1,162 @@
+"""AutoTP — automatic tensor-parallel sharding from a parameter walk.
+
+TPU-native counterpart of the reference's ``AutoTP``
+(``deepspeed/module_inject/auto_tp.py:170``) and
+``ReplaceWithTensorSlicing`` (:19): the reference parses the module graph to
+find which Linears feed an all-reduce and physically slices their weights
+per rank; here the same walk runs over the *parameter pytree* and emits
+GSPMD ``PartitionSpec``s over the ``model`` mesh axis — the XLA partitioner
+then inserts exactly the all-reduces the reference's ``LinearAllreduce``
+performs by hand.
+
+Classification (the reference's policy, module_inject/layers.py:15,32):
+* column-parallel (shard OUTPUT features): q/k/v/gate/up/fc-in projections —
+  any matmul whose output feeds a nonlinearity or head-split;
+* row-parallel (shard INPUT features): attention-out and fc-out projections —
+  their outputs sum across ranks (the all-reduce point);
+* replicated: norms, biases of row-parallel layers, embeddings (or
+  vocab-sharded when requested).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+# name-pattern tables (matched against the last path component, lowercase)
+COLUMN_PATTERNS = [
+    r"w?q(_proj|_lin)?$", r"w?k(_proj|_lin)?$", r"w?v(_proj|_lin)?$",
+    r"(w_)?qkv(_proj)?$", r"query(_key_value)?$", r"key$", r"value$",
+    r"(w_)?gate(_proj)?$", r"(w_)?up(_proj)?$", r"w_in$", r"fc1$", r"c_fc$",
+    r"wi(_\d+)?$", r"dense_h_to_4h$", r"intermediate$",
+]
+ROW_PATTERNS = [
+    r"w?o(_proj|ut_proj)?$", r"out(_proj)?$", r"w_out$", r"fc2$", r"c_proj$",
+    r"wo$", r"dense_4h_to_h$", r"attn_out$", r"dense$", r"o_proj$", r"down_proj$",
+]
+VOCAB_PATTERNS = [r"tokens$", r"wte$", r"embed_tokens$", r"word_embeddings$", r"lm_head$"]
+NORM_PATTERNS = [r".*norm.*", r"ln_\w+$", r".*layernorm.*"]
+
+
+def _matches(name: str, patterns: List[str]) -> bool:
+    return any(re.fullmatch(p, name) for p in patterns)
+
+
+class Classification:
+    COLUMN = "column"
+    ROW = "row"
+    VOCAB = "vocab"
+    REPLICATE = "replicate"
+
+
+def classify_param(path: str) -> str:
+    """Classify one parameter by its tree path (reference AutoTP
+    ``tp_parser`` semantics via names instead of graph ops)."""
+    name = path.split("/")[-1].lower()
+    if _matches(name, NORM_PATTERNS):
+        return Classification.REPLICATE
+    if _matches(name, COLUMN_PATTERNS):
+        return Classification.COLUMN
+    if _matches(name, ROW_PATTERNS):
+        return Classification.ROW
+    if _matches(name, VOCAB_PATTERNS):
+        return Classification.VOCAB
+    return Classification.REPLICATE
+
+
+def spec_for_param(path: str, shape: Tuple[int, ...], mp_axis: str = "model") -> P:
+    """PartitionSpec for one leaf. Stacked [L, in, out] layer weights keep
+    the leading scan dim unsharded (the flagship model layout)."""
+    kind = classify_param(path)
+    nd = len(shape)
+    if nd == 0 or kind == Classification.REPLICATE:
+        return P(*([None] * nd))
+    stacked = nd == 3
+    if kind == Classification.COLUMN:
+        # shard output features (last dim); 1-D bias of a column layer
+        # shards its only dim
+        if nd == 1:
+            return P(mp_axis)
+        return P(None, None, mp_axis) if stacked else P(None, mp_axis)
+    if kind == Classification.ROW:
+        # shard input features (second-to-last dim); row biases replicate
+        # (they are added after the all-reduce)
+        if nd == 1:
+            return P(None)
+        return P(None, mp_axis, None) if stacked else P(mp_axis, None)
+    if kind == Classification.VOCAB:
+        if nd == 1:
+            return P(None)
+        name = path.split("/")[-1].lower()
+        if name == "lm_head":
+            return P(None, mp_axis)  # output-vocab sharded
+        return P(mp_axis, None)  # input-vocab sharded embedding
+    return P(*([None] * nd))
+
+
+class AutoTP:
+    """Emit a PartitionSpec tree for an arbitrary param pytree
+    (reference AutoTP class, auto_tp.py:170)."""
+
+    def __init__(self, mp_axis: str = "model", overrides: Optional[Dict[str, P]] = None):
+        self.mp_axis = mp_axis
+        self.overrides = overrides or {}
+
+    def partition_specs(self, params_shapes: Any) -> Any:
+        def walk(prefix: str, tree):
+            if isinstance(tree, dict):
+                return {k: walk(f"{prefix}/{k}", v) for k, v in tree.items()}
+            if isinstance(tree, (list, tuple)):
+                out = [walk(f"{prefix}/{i}", v) for i, v in enumerate(tree)]
+                return type(tree)(out)
+            shape = tuple(getattr(tree, "shape", np.shape(tree)))
+            for pat, spec in self.overrides.items():
+                if re.fullmatch(pat, prefix):
+                    return spec
+            return spec_for_param(prefix, shape, self.mp_axis)
+
+        return walk("", params_shapes)
+
+    def validate(self, params_shapes: Any, specs: Any, mp_size: int) -> List[str]:
+        """Report leaves whose sharded dim is not divisible by mp_size
+        (the reference errors at slice time; we surface it up front)."""
+        problems: List[str] = []
+
+        def walk(prefix, tree, spec):
+            if isinstance(tree, dict):
+                for k in tree:
+                    walk(f"{prefix}/{k}", tree[k], spec[k])
+                return
+            shape = tuple(getattr(tree, "shape", np.shape(tree)))
+            for dim, entry in zip(shape, tuple(spec) + (None,) * len(shape)):
+                if entry is not None and dim % mp_size != 0:
+                    problems.append(f"{prefix}: dim {dim} not divisible by mp={mp_size}")
+
+        walk("", params_shapes, specs)
+        return problems
+
+
+class ReplaceWithTensorSlicing:
+    """Physically slice a host weight for one model-parallel rank —
+    used by the sharded checkpoint loader when weights arrive as full host
+    arrays (reference module_inject/auto_tp.py:19)."""
+
+    def __init__(self, mp_rank: int = 0, mp_size: int = 1, mp_axis: str = "model"):
+        self.mp_rank = mp_rank
+        self.mp_size = mp_size
+        self.mp_axis = mp_axis
+
+    def shard(self, path: str, weight: np.ndarray) -> np.ndarray:
+        spec = spec_for_param(path, weight.shape, self.mp_axis)
+        for axis, entry in enumerate(spec):
+            if entry == self.mp_axis:
+                dim = weight.shape[axis]
+                assert dim % self.mp_size == 0, f"{path}: {dim} % {self.mp_size} != 0"
+                size = dim // self.mp_size
+                sl = [slice(None)] * weight.ndim
+                sl[axis] = slice(self.mp_rank * size, (self.mp_rank + 1) * size)
+                return weight[tuple(sl)]
+        return weight

@@ -1,0 +1,407 @@
+"""Per-architecture injection policies.
+
+Counterpart of the reference's policy/container layer
+(``deepspeed/module_inject/containers/`` + ``replace_policy.py``): a policy
+knows how to map one HuggingFace architecture onto the fused TPU decoder
+(``models/transformer.py TransformerLM`` — the analog of
+``DeepSpeedTransformerInference``): config translation + weight-layout
+conversion (attention/mlp extraction, the reference's
+``TransformerPolicy.attention()/mlp()`` contract).
+
+Weights arrive as a flat HF state dict of numpy arrays (from torch or
+safetensors); ``convert_weights`` re-lays them into the stacked [L, ...]
+param tree, transposing torch's [out, in] Linear convention to the [in, out]
+matmul layout the TPU model uses.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.models.config import TransformerConfig
+
+
+def _t(w: np.ndarray) -> np.ndarray:
+    """torch Linear [out, in] → matmul [in, out]."""
+    return np.ascontiguousarray(np.asarray(w).T)
+
+
+def _stack(arrs: List[np.ndarray]) -> np.ndarray:
+    return np.stack([np.asarray(a) for a in arrs], axis=0)
+
+
+class DSPolicy:
+    """Base policy (reference module_inject/policy.py:224 DSPolicy)."""
+
+    model_types: List[str] = []
+
+    @classmethod
+    def matches(cls, model_type: str) -> bool:
+        return model_type.lower() in cls.model_types
+
+    def build_config(self, hf_config) -> TransformerConfig:
+        raise NotImplementedError
+
+    def convert_weights(self, sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict[str, Any]:
+        raise NotImplementedError
+
+
+class GPT2Policy(DSPolicy):
+    """gpt2 (reference containers/gpt2.py): learned positions, gelu, fused
+    c_attn qkv, Conv1D weights already [in, out]."""
+
+    model_types = ["gpt2"]
+
+    def build_config(self, c) -> TransformerConfig:
+        return TransformerConfig(
+            vocab_size=c.vocab_size,
+            hidden_size=c.n_embd,
+            num_layers=c.n_layer,
+            num_heads=c.n_head,
+            max_seq_len=c.n_positions,
+            norm="layernorm",
+            position="learned",
+            activation="gelu",
+            use_bias=True,
+            tie_embeddings=True,
+        )
+
+    def convert_weights(self, sd, cfg) -> Dict[str, Any]:
+        L, H = cfg.num_layers, cfg.hidden_size
+        # HF GPT-2 Conv1D stores [in, out] already — no transpose
+        qkv = [np.asarray(sd[f"h.{i}.attn.c_attn.weight"]) for i in range(L)]
+        qkv_b = [np.asarray(sd[f"h.{i}.attn.c_attn.bias"]) for i in range(L)]
+        layer = {
+            "attn_norm_scale": _stack([sd[f"h.{i}.ln_1.weight"] for i in range(L)]),
+            "attn_norm_bias": _stack([sd[f"h.{i}.ln_1.bias"] for i in range(L)]),
+            "wq": _stack([w[:, :H] for w in qkv]),
+            "wk": _stack([w[:, H : 2 * H] for w in qkv]),
+            "wv": _stack([w[:, 2 * H :] for w in qkv]),
+            "bq": _stack([b[:H] for b in qkv_b]),
+            "bk": _stack([b[H : 2 * H] for b in qkv_b]),
+            "bv": _stack([b[2 * H :] for b in qkv_b]),
+            "wo": _stack([sd[f"h.{i}.attn.c_proj.weight"] for i in range(L)]),
+            "bo": _stack([sd[f"h.{i}.attn.c_proj.bias"] for i in range(L)]),
+            "mlp_norm_scale": _stack([sd[f"h.{i}.ln_2.weight"] for i in range(L)]),
+            "mlp_norm_bias": _stack([sd[f"h.{i}.ln_2.bias"] for i in range(L)]),
+            "w_in": _stack([sd[f"h.{i}.mlp.c_fc.weight"] for i in range(L)]),
+            "b_in": _stack([sd[f"h.{i}.mlp.c_fc.bias"] for i in range(L)]),
+            "w_out": _stack([sd[f"h.{i}.mlp.c_proj.weight"] for i in range(L)]),
+            "b_out": _stack([sd[f"h.{i}.mlp.c_proj.bias"] for i in range(L)]),
+        }
+        return {
+            "embed": {"tokens": np.asarray(sd["wte.weight"]), "pos": np.asarray(sd["wpe.weight"])},
+            "layers": layer,
+            "final_norm_scale": np.asarray(sd["ln_f.weight"]),
+            "final_norm_bias": np.asarray(sd["ln_f.bias"]),
+        }
+
+
+class LlamaPolicy(DSPolicy):
+    """llama/llama2 + mistral (reference containers/llama.py): RMSNorm,
+    RoPE, SwiGLU, GQA, untied head."""
+
+    model_types = ["llama", "mistral"]
+
+    def build_config(self, c) -> TransformerConfig:
+        return TransformerConfig(
+            vocab_size=c.vocab_size,
+            hidden_size=c.hidden_size,
+            intermediate_size=c.intermediate_size,
+            num_layers=c.num_hidden_layers,
+            num_heads=c.num_attention_heads,
+            num_kv_heads=getattr(c, "num_key_value_heads", c.num_attention_heads),
+            max_seq_len=getattr(c, "max_position_embeddings", 4096),
+            norm="rmsnorm",
+            norm_eps=getattr(c, "rms_norm_eps", 1e-5),
+            position="rope",
+            rope_theta=getattr(c, "rope_theta", 10000.0),
+            activation="swiglu",
+            use_bias=False,
+            tie_embeddings=getattr(c, "tie_word_embeddings", False),
+        )
+
+    def convert_weights(self, sd, cfg) -> Dict[str, Any]:
+        L = cfg.num_layers
+
+        def lw(i, name):
+            return _t(sd[f"model.layers.{i}.{name}.weight"])
+
+        layer = {
+            "attn_norm_scale": _stack(
+                [sd[f"model.layers.{i}.input_layernorm.weight"] for i in range(L)]
+            ),
+            "wq": _stack([lw(i, "self_attn.q_proj") for i in range(L)]),
+            "wk": _stack([lw(i, "self_attn.k_proj") for i in range(L)]),
+            "wv": _stack([lw(i, "self_attn.v_proj") for i in range(L)]),
+            "wo": _stack([lw(i, "self_attn.o_proj") for i in range(L)]),
+            "mlp_norm_scale": _stack(
+                [sd[f"model.layers.{i}.post_attention_layernorm.weight"] for i in range(L)]
+            ),
+            "w_gate": _stack([lw(i, "mlp.gate_proj") for i in range(L)]),
+            "w_up": _stack([lw(i, "mlp.up_proj") for i in range(L)]),
+            "w_out": _stack([lw(i, "mlp.down_proj") for i in range(L)]),
+        }
+        params = {
+            "embed": {"tokens": np.asarray(sd["model.embed_tokens.weight"])},
+            "layers": layer,
+            "final_norm_scale": np.asarray(sd["model.norm.weight"]),
+        }
+        if not cfg.tie_embeddings:
+            params["lm_head"] = _t(sd["lm_head.weight"])
+        return params
+
+
+class OPTPolicy(DSPolicy):
+    """opt (reference containers/opt.py): learned positions (offset 2 handled
+    by caller), relu, layernorm, tied head."""
+
+    model_types = ["opt"]
+
+    def build_config(self, c) -> TransformerConfig:
+        return TransformerConfig(
+            vocab_size=c.vocab_size,
+            hidden_size=c.hidden_size,
+            intermediate_size=c.ffn_dim,
+            num_layers=c.num_hidden_layers,
+            num_heads=c.num_attention_heads,
+            max_seq_len=c.max_position_embeddings,
+            norm="layernorm",
+            position="learned",
+            activation="relu",
+            use_bias=True,
+            tie_embeddings=True,
+        )
+
+    def convert_weights(self, sd, cfg) -> Dict[str, Any]:
+        L = cfg.num_layers
+        pre = "model.decoder."
+
+        def lw(i, name):
+            return _t(sd[f"{pre}layers.{i}.{name}.weight"])
+
+        def lb(i, name):
+            return np.asarray(sd[f"{pre}layers.{i}.{name}.bias"])
+
+        layer = {
+            "attn_norm_scale": _stack([sd[f"{pre}layers.{i}.self_attn_layer_norm.weight"] for i in range(L)]),
+            "attn_norm_bias": _stack([sd[f"{pre}layers.{i}.self_attn_layer_norm.bias"] for i in range(L)]),
+            "wq": _stack([lw(i, "self_attn.q_proj") for i in range(L)]),
+            "wk": _stack([lw(i, "self_attn.k_proj") for i in range(L)]),
+            "wv": _stack([lw(i, "self_attn.v_proj") for i in range(L)]),
+            "bq": _stack([lb(i, "self_attn.q_proj") for i in range(L)]),
+            "bk": _stack([lb(i, "self_attn.k_proj") for i in range(L)]),
+            "bv": _stack([lb(i, "self_attn.v_proj") for i in range(L)]),
+            "wo": _stack([lw(i, "self_attn.out_proj") for i in range(L)]),
+            "bo": _stack([lb(i, "self_attn.out_proj") for i in range(L)]),
+            "mlp_norm_scale": _stack([sd[f"{pre}layers.{i}.final_layer_norm.weight"] for i in range(L)]),
+            "mlp_norm_bias": _stack([sd[f"{pre}layers.{i}.final_layer_norm.bias"] for i in range(L)]),
+            "w_in": _stack([lw(i, "fc1") for i in range(L)]),
+            "b_in": _stack([lb(i, "fc1") for i in range(L)]),
+            "w_out": _stack([lw(i, "fc2") for i in range(L)]),
+            "b_out": _stack([lb(i, "fc2") for i in range(L)]),
+        }
+        # OPT's positional table has a +2 offset; rows 2: align to position 0
+        pos = np.asarray(sd[f"{pre}embed_positions.weight"])[2:]
+        return {
+            "embed": {"tokens": np.asarray(sd[f"{pre}embed_tokens.weight"]), "pos": pos},
+            "layers": layer,
+            "final_norm_scale": np.asarray(sd[f"{pre}final_layer_norm.weight"]),
+            "final_norm_bias": np.asarray(sd[f"{pre}final_layer_norm.bias"]),
+        }
+
+
+class GPTNeoXPolicy(DSPolicy):
+    """gpt_neox (reference containers/gptneox.py): rope, gelu, fused qkv."""
+
+    model_types = ["gpt_neox", "gptneox"]
+
+    def build_config(self, c) -> TransformerConfig:
+        return TransformerConfig(
+            vocab_size=c.vocab_size,
+            hidden_size=c.hidden_size,
+            intermediate_size=c.intermediate_size,
+            num_layers=c.num_hidden_layers,
+            num_heads=c.num_attention_heads,
+            max_seq_len=c.max_position_embeddings,
+            norm="layernorm",
+            position="rope",
+            rope_theta=getattr(c, "rotary_emb_base", 10000.0),
+            activation="gelu",
+            use_bias=True,
+            tie_embeddings=False,
+        )
+
+    def convert_weights(self, sd, cfg) -> Dict[str, Any]:
+        L, H = cfg.num_layers, cfg.hidden_size
+        NH, D = cfg.num_heads, cfg.head_dim
+        pre = "gpt_neox."
+        wqs, wks, wvs, bqs, bks, bvs = [], [], [], [], [], []
+        for i in range(L):
+            # neox fuses qkv interleaved per head: [NH, 3, D, H]
+            w = np.asarray(sd[f"{pre}layers.{i}.attention.query_key_value.weight"])
+            b = np.asarray(sd[f"{pre}layers.{i}.attention.query_key_value.bias"])
+            w = w.reshape(NH, 3, D, H)
+            b = b.reshape(NH, 3, D)
+            wqs.append(np.ascontiguousarray(w[:, 0].reshape(NH * D, H).T))
+            wks.append(np.ascontiguousarray(w[:, 1].reshape(NH * D, H).T))
+            wvs.append(np.ascontiguousarray(w[:, 2].reshape(NH * D, H).T))
+            bqs.append(b[:, 0].reshape(-1))
+            bks.append(b[:, 1].reshape(-1))
+            bvs.append(b[:, 2].reshape(-1))
+        layer = {
+            "attn_norm_scale": _stack([sd[f"{pre}layers.{i}.input_layernorm.weight"] for i in range(L)]),
+            "attn_norm_bias": _stack([sd[f"{pre}layers.{i}.input_layernorm.bias"] for i in range(L)]),
+            "wq": _stack(wqs),
+            "wk": _stack(wks),
+            "wv": _stack(wvs),
+            "bq": _stack(bqs),
+            "bk": _stack(bks),
+            "bv": _stack(bvs),
+            "wo": _stack([_t(sd[f"{pre}layers.{i}.attention.dense.weight"]) for i in range(L)]),
+            "bo": _stack([sd[f"{pre}layers.{i}.attention.dense.bias"] for i in range(L)]),
+            "mlp_norm_scale": _stack([sd[f"{pre}layers.{i}.post_attention_layernorm.weight"] for i in range(L)]),
+            "mlp_norm_bias": _stack([sd[f"{pre}layers.{i}.post_attention_layernorm.bias"] for i in range(L)]),
+            "w_in": _stack([_t(sd[f"{pre}layers.{i}.mlp.dense_h_to_4h.weight"]) for i in range(L)]),
+            "b_in": _stack([sd[f"{pre}layers.{i}.mlp.dense_h_to_4h.bias"] for i in range(L)]),
+            "w_out": _stack([_t(sd[f"{pre}layers.{i}.mlp.dense_4h_to_h.weight"]) for i in range(L)]),
+            "b_out": _stack([sd[f"{pre}layers.{i}.mlp.dense_4h_to_h.bias"] for i in range(L)]),
+        }
+        return {
+            "embed": {"tokens": np.asarray(sd[f"{pre}embed_in.weight"])},
+            "layers": layer,
+            "final_norm_scale": np.asarray(sd[f"{pre}final_layer_norm.weight"]),
+            "final_norm_bias": np.asarray(sd[f"{pre}final_layer_norm.bias"]),
+            "lm_head": _t(sd["embed_out.weight"]),
+        }
+
+
+class BloomPolicy(DSPolicy):
+    """bloom (reference containers/bloom.py): alibi positions, gelu."""
+
+    model_types = ["bloom"]
+
+    def build_config(self, c) -> TransformerConfig:
+        return TransformerConfig(
+            vocab_size=c.vocab_size,
+            hidden_size=c.hidden_size,
+            num_layers=c.n_layer,
+            num_heads=c.n_head,
+            max_seq_len=2048,
+            norm="layernorm",
+            position="alibi",
+            activation="gelu",
+            use_bias=True,
+            tie_embeddings=True,
+        )
+
+    def convert_weights(self, sd, cfg) -> Dict[str, Any]:
+        L, H = cfg.num_layers, cfg.hidden_size
+        NH, D = cfg.num_heads, cfg.head_dim
+        pre = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+        wqs, wks, wvs, bqs, bks, bvs = [], [], [], [], [], []
+        for i in range(L):
+            w = np.asarray(sd[f"{pre}h.{i}.self_attention.query_key_value.weight"])
+            b = np.asarray(sd[f"{pre}h.{i}.self_attention.query_key_value.bias"])
+            w = w.reshape(NH, 3, D, H)
+            b = b.reshape(NH, 3, D)
+            wqs.append(np.ascontiguousarray(w[:, 0].reshape(NH * D, H).T))
+            wks.append(np.ascontiguousarray(w[:, 1].reshape(NH * D, H).T))
+            wvs.append(np.ascontiguousarray(w[:, 2].reshape(NH * D, H).T))
+            bqs.append(b[:, 0].reshape(-1))
+            bks.append(b[:, 1].reshape(-1))
+            bvs.append(b[:, 2].reshape(-1))
+        layer = {
+            "attn_norm_scale": _stack([sd[f"{pre}h.{i}.input_layernorm.weight"] for i in range(L)]),
+            "attn_norm_bias": _stack([sd[f"{pre}h.{i}.input_layernorm.bias"] for i in range(L)]),
+            "wq": _stack(wqs), "wk": _stack(wks), "wv": _stack(wvs),
+            "bq": _stack(bqs), "bk": _stack(bks), "bv": _stack(bvs),
+            "wo": _stack([_t(sd[f"{pre}h.{i}.self_attention.dense.weight"]) for i in range(L)]),
+            "bo": _stack([sd[f"{pre}h.{i}.self_attention.dense.bias"] for i in range(L)]),
+            "mlp_norm_scale": _stack([sd[f"{pre}h.{i}.post_attention_layernorm.weight"] for i in range(L)]),
+            "mlp_norm_bias": _stack([sd[f"{pre}h.{i}.post_attention_layernorm.bias"] for i in range(L)]),
+            "w_in": _stack([_t(sd[f"{pre}h.{i}.mlp.dense_h_to_4h.weight"]) for i in range(L)]),
+            "b_in": _stack([sd[f"{pre}h.{i}.mlp.dense_h_to_4h.bias"] for i in range(L)]),
+            "w_out": _stack([_t(sd[f"{pre}h.{i}.mlp.dense_4h_to_h.weight"]) for i in range(L)]),
+            "b_out": _stack([sd[f"{pre}h.{i}.mlp.dense_4h_to_h.bias"] for i in range(L)]),
+        }
+        return {
+            "embed": {"tokens": np.asarray(sd[f"{pre}word_embeddings.weight"])},
+            "layers": layer,
+            "final_norm_scale": np.asarray(sd[f"{pre}ln_f.weight"]),
+            "final_norm_bias": np.asarray(sd[f"{pre}ln_f.bias"]),
+        }
+
+
+class GPTJPolicy(DSPolicy):
+    """gptj (reference containers/gptj.py): rope (partial), gelu, untied head.
+    Note: HF GPT-J applies rotary to only ``rotary_dim`` dims; this port
+    applies full-head rope — exact parity requires rotary_dim == head_dim."""
+
+    model_types = ["gptj"]
+
+    def build_config(self, c) -> TransformerConfig:
+        return TransformerConfig(
+            vocab_size=c.vocab_size,
+            hidden_size=c.n_embd,
+            num_layers=c.n_layer,
+            num_heads=c.n_head,
+            max_seq_len=c.n_positions,
+            norm="layernorm",
+            position="rope",
+            activation="gelu",
+            use_bias=True,
+            qkv_bias=False,
+            tie_embeddings=False,
+        )
+
+    def convert_weights(self, sd, cfg) -> Dict[str, Any]:
+        L = cfg.num_layers
+        pre = "transformer."
+        layer = {
+            "attn_norm_scale": _stack([sd[f"{pre}h.{i}.ln_1.weight"] for i in range(L)]),
+            "attn_norm_bias": _stack([sd[f"{pre}h.{i}.ln_1.bias"] for i in range(L)]),
+            "wq": _stack([_t(sd[f"{pre}h.{i}.attn.q_proj.weight"]) for i in range(L)]),
+            "wk": _stack([_t(sd[f"{pre}h.{i}.attn.k_proj.weight"]) for i in range(L)]),
+            "wv": _stack([_t(sd[f"{pre}h.{i}.attn.v_proj.weight"]) for i in range(L)]),
+            "wo": _stack([_t(sd[f"{pre}h.{i}.attn.out_proj.weight"]) for i in range(L)]),
+            "bo": _stack([np.zeros(cfg.hidden_size, np.float32) for _ in range(L)]),
+            # GPT-J is parallel-attention+mlp off ln_1; sequential port reuses
+            # ln_1 weights for the mlp branch (close approximation)
+            "mlp_norm_scale": _stack([sd[f"{pre}h.{i}.ln_1.weight"] for i in range(L)]),
+            "mlp_norm_bias": _stack([sd[f"{pre}h.{i}.ln_1.bias"] for i in range(L)]),
+            "w_in": _stack([_t(sd[f"{pre}h.{i}.mlp.fc_in.weight"]) for i in range(L)]),
+            "b_in": _stack([sd[f"{pre}h.{i}.mlp.fc_in.bias"] for i in range(L)]),
+            "w_out": _stack([_t(sd[f"{pre}h.{i}.mlp.fc_out.weight"]) for i in range(L)]),
+            "b_out": _stack([sd[f"{pre}h.{i}.mlp.fc_out.bias"] for i in range(L)]),
+        }
+        return {
+            "embed": {"tokens": np.asarray(sd[f"{pre}wte.weight"])},
+            "layers": layer,
+            "final_norm_scale": np.asarray(sd[f"{pre}ln_f.weight"]),
+            "final_norm_bias": np.asarray(sd[f"{pre}ln_f.bias"]),
+            "lm_head": _t(sd["lm_head.weight"]),
+        }
+
+
+# registry (reference replace_policy.py replace_policies)
+replace_policies: List[type] = [
+    GPT2Policy,
+    LlamaPolicy,
+    OPTPolicy,
+    GPTNeoXPolicy,
+    BloomPolicy,
+    GPTJPolicy,
+]
+
+
+def policy_for(model_type: str) -> DSPolicy:
+    for cls in replace_policies:
+        if cls.matches(model_type):
+            return cls()
+    raise ValueError(
+        f"no injection policy for architecture {model_type!r}; "
+        f"known: {[t for c in replace_policies for t in c.model_types]}"
+    )

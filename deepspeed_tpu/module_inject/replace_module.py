@@ -1,0 +1,90 @@
+"""Module replacement entry points.
+
+Counterpart of the reference's ``replace_transformer_layer``
+(``deepspeed/module_inject/replace_module.py:181``): instead of swapping
+``nn.Module`` instances for kernel-injected ones in place, the TPU path
+converts the whole model — HF config + state dict → the fused TPU decoder
+(``TransformerLM``) with converted weights, AutoTP PartitionSpecs, and the
+KV-cache decode programs (``inference/decode.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.models.transformer import TransformerLM
+from deepspeed_tpu.module_inject.auto_tp import AutoTP
+from deepspeed_tpu.module_inject.containers import DSPolicy, policy_for
+from deepspeed_tpu.utils.logging import log_dist
+
+
+def _hf_state_dict_to_numpy(model) -> Dict[str, np.ndarray]:
+    """Flat numpy state dict from a torch model / state dict / numpy dict."""
+    if hasattr(model, "state_dict"):
+        sd = model.state_dict()
+    else:
+        sd = model
+    out = {}
+    for k, v in sd.items():
+        if hasattr(v, "detach"):
+            v = v.detach().cpu().float().numpy()
+        out[k] = np.asarray(v)
+    return out
+
+
+def _strip_known_prefixes(sd: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """HF *ForCausalLM wrappers prefix the base model (transformer./model.);
+    policies expect specific prefixes — normalize gpt2's 'transformer.'."""
+    if any(k.startswith("transformer.h.") for k in sd):
+        return {k[len("transformer.") :] if k.startswith("transformer.") else k: v for k, v in sd.items()}
+    return sd
+
+
+def replace_transformer_layer(
+    orig_layer_impl=None,  # noqa: ARG001 - reference signature parity
+    model=None,
+    checkpoint_dict=None,  # noqa: ARG001 - sharded ckpt loading via engine
+    config=None,
+    model_config=None,
+    dtype: Optional[str] = None,
+) -> Tuple[TransformerLM, Optional[Dict[str, Any]]]:
+    """Convert an HF model (or its config) to the injected TPU decoder.
+
+    Returns ``(ds_model, params)`` — params is None when only a config was
+    given (weights then come from a checkpoint or fresh init).
+    """
+    hf_config = model_config
+    if hf_config is None and model is not None and hasattr(model, "config"):
+        hf_config = model.config
+    if hf_config is None:
+        raise ValueError("replace_transformer_layer needs model or model_config")
+    model_type = getattr(hf_config, "model_type", None) or type(hf_config).__name__
+    policy = policy_for(model_type)
+    ds_config = policy.build_config(hf_config)
+    if dtype is not None:
+        ds_config.dtype = dtype
+    ds_model = TransformerLM(ds_config)
+    log_dist(
+        f"module_inject: {model_type} → TransformerLM "
+        f"(L={ds_config.num_layers}, H={ds_config.hidden_size}, "
+        f"heads={ds_config.num_heads}/{ds_config.num_kv_heads})",
+        ranks=[0],
+    )
+    params = None
+    if model is not None and not isinstance(model, type):
+        sd = _strip_known_prefixes(_hf_state_dict_to_numpy(model))
+        params = policy.convert_weights(sd, ds_config)
+    return ds_model, params
+
+
+def generic_injection(model, dtype=None, enable_cuda_graph=False):  # noqa: ARG001
+    """Diffusers-style generic injection (reference replace_module.py:86) —
+    not applicable on the decoder path; retained for API parity."""
+    return model
+
+
+def tp_shard_specs(params_shapes: Any, mp_axis: str = "model") -> Any:
+    """AutoTP over an arbitrary param tree (reference AutoTP entry)."""
+    return AutoTP(mp_axis=mp_axis).partition_specs(params_shapes)

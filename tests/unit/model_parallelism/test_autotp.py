@@ -1,0 +1,107 @@
+"""AutoTP tests (reference: ``tests/unit/model_parallelism/``)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.module_inject import (
+    AutoTP,
+    Classification,
+    ReplaceWithTensorSlicing,
+    classify_param,
+    spec_for_param,
+)
+
+
+class TestClassification:
+    @pytest.mark.parametrize(
+        "name,expected",
+        [
+            ("layers/wq", Classification.COLUMN),
+            ("layers/q_proj", Classification.COLUMN),
+            ("layers/gate_proj", Classification.COLUMN),
+            ("layers/c_fc", Classification.COLUMN),
+            ("layers/dense_h_to_4h", Classification.COLUMN),
+            ("layers/wo", Classification.ROW),
+            ("layers/o_proj", Classification.ROW),
+            ("layers/down_proj", Classification.ROW),
+            ("layers/c_proj", Classification.ROW),
+            ("layers/dense_4h_to_h", Classification.ROW),
+            ("embed/tokens", Classification.VOCAB),
+            ("lm_head", Classification.VOCAB),
+            ("layers/attn_norm_scale", Classification.REPLICATE),
+            ("final_norm_bias", Classification.REPLICATE),
+        ],
+    )
+    def test_classify(self, name, expected):
+        assert classify_param(name) == expected
+
+
+class TestSpecs:
+    def test_column_2d(self):
+        assert spec_for_param("wq", (64, 128)) == P(None, "model")
+
+    def test_column_stacked(self):
+        assert spec_for_param("layers/wq", (4, 64, 128)) == P(None, None, "model")
+
+    def test_row_2d(self):
+        assert spec_for_param("wo", (128, 64)) == P("model", None)
+
+    def test_row_bias_replicated(self):
+        assert spec_for_param("bo", (64,)) == P(None)
+
+    def test_vocab_embedding(self):
+        assert spec_for_param("embed/tokens", (50257, 768)) == P("model", None)
+
+    def test_lm_head(self):
+        assert spec_for_param("lm_head", (768, 50257)) == P(None, "model")
+
+
+class TestAutoTPTree:
+    def test_partition_specs_tree(self):
+        shapes = {
+            "embed": {"tokens": np.zeros((100, 16))},
+            "layers": {
+                "wq": np.zeros((2, 16, 32)),
+                "wo": np.zeros((2, 32, 16)),
+                "attn_norm_scale": np.zeros((2, 16)),
+            },
+        }
+        specs = AutoTP().partition_specs(shapes)
+        assert specs["layers"]["wq"] == P(None, None, "model")
+        assert specs["layers"]["wo"] == P(None, "model", None)
+        assert specs["layers"]["attn_norm_scale"] == P(None, None)
+        assert specs["embed"]["tokens"] == P("model", None)
+
+    def test_validate_divisibility(self):
+        shapes = {"wq": np.zeros((16, 30))}  # 30 % 4 != 0
+        tp = AutoTP()
+        specs = tp.partition_specs(shapes)
+        problems = tp.validate(shapes, specs, mp_size=4)
+        assert problems and "wq" in problems[0]
+
+    def test_overrides(self):
+        shapes = {"custom": np.zeros((8, 8))}
+        specs = AutoTP(overrides={"/custom": P("model", None)}).partition_specs(shapes)
+        assert specs["custom"] == P("model", None)
+
+
+class TestTensorSlicing:
+    def test_column_shard(self):
+        w = np.arange(32).reshape(4, 8).astype(np.float32)
+        slicer = ReplaceWithTensorSlicing(mp_rank=1, mp_size=2)
+        out = slicer.shard("wq", w)
+        np.testing.assert_array_equal(out, w[:, 4:])
+
+    def test_row_shard(self):
+        w = np.arange(32).reshape(8, 4).astype(np.float32)
+        slicer = ReplaceWithTensorSlicing(mp_rank=0, mp_size=2)
+        out = slicer.shard("wo", w)
+        np.testing.assert_array_equal(out, w[:4, :])
+
+    def test_replicated_passthrough(self):
+        w = np.ones((6,), np.float32)
+        out = ReplaceWithTensorSlicing(0, 2).shard("norm_scale", w)
+        np.testing.assert_array_equal(out, w)
