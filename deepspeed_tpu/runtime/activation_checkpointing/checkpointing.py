@@ -1,0 +1,145 @@
+"""Activation checkpointing.
+
+Counterpart of the reference's Megatron-derived checkpointing
+(``deepspeed/runtime/activation_checkpointing/checkpointing.py``:
+``CheckpointFunction`` :475, ``configure`` :1055, partitioned/CPU/contiguous
+activation options). On TPU the mechanism is ``jax.checkpoint`` (remat):
+instead of saving activations and replaying autograd, XLA recomputes the
+wrapped region in the backward pass, with a *policy* choosing what to keep.
+
+Config translation (JSON keys are the reference's, ``configure`` semantics):
+
+* ``partition_activations``  → policy keeps nothing across the region and
+  the saved residuals are sharded by GSPMD anyway (sharded-by-construction —
+  the reference's cross-mp-rank partitioning is what PartitionSpecs already
+  do to the saved tensors);
+* ``cpu_checkpointing``      → ``jax.checkpoint`` with offload policy
+  (``save_and_offload_only_these_names`` host offload when available);
+* ``contiguous_memory_optimization`` / ``number_checkpoints`` → no-ops
+  (XLA's allocator packs remat buffers);
+* ``synchronize_checkpoint_boundary`` → no-op (no streams to sync).
+
+``checkpoint(fn, *args)`` matches the reference's call surface
+(checkpointing.py:954) and the RNG plumbing is jax-native: pass rngs
+explicitly — deterministic replay is automatic because jax PRNG keys are
+values, which is what the reference's ``CudaRNGStatesTracker`` (:122)
+reconstructs by hand.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+
+from deepspeed_tpu.utils.logging import logger
+
+_config: dict = {
+    "partition_activations": False,
+    "cpu_checkpointing": False,
+    "contiguous_memory_optimization": False,
+    "number_checkpoints": None,
+    "synchronize_checkpoint_boundary": False,
+    "profile": False,
+}
+_configured = False
+
+# policy table: reference knob combinations → jax.checkpoint policies
+_POLICIES = {
+    "default": None,  # save nothing; recompute everything (max memory saving)
+    "dots": "checkpoint_dots",
+    "dots_no_batch": "checkpoint_dots_with_no_batch_dims",
+    "nothing": "nothing_saveable",
+    "everything": "everything_saveable",
+}
+
+
+def configure(
+    mpu_=None,  # noqa: ARG001 - reference parity (mesh already global)
+    deepspeed_config=None,
+    partition_activations: Optional[bool] = None,
+    contiguous_checkpointing: Optional[bool] = None,
+    num_checkpoints: Optional[int] = None,
+    checkpoint_in_cpu: Optional[bool] = None,
+    synchronize: Optional[bool] = None,
+    profile: Optional[bool] = None,
+) -> None:
+    """(reference :1055) — accepts both the config object and kwargs."""
+    global _configured
+    cfg = None
+    if deepspeed_config is not None:
+        cfg = getattr(deepspeed_config, "activation_checkpointing_config", None)
+    if cfg is not None:
+        _config["partition_activations"] = cfg.partition_activations
+        _config["cpu_checkpointing"] = cfg.cpu_checkpointing
+        _config["contiguous_memory_optimization"] = cfg.contiguous_memory_optimization
+        _config["number_checkpoints"] = cfg.number_checkpoints
+        _config["synchronize_checkpoint_boundary"] = cfg.synchronize_checkpoint_boundary
+        _config["profile"] = cfg.profile
+    for key, val in [
+        ("partition_activations", partition_activations),
+        ("contiguous_memory_optimization", contiguous_checkpointing),
+        ("number_checkpoints", num_checkpoints),
+        ("cpu_checkpointing", checkpoint_in_cpu),
+        ("synchronize_checkpoint_boundary", synchronize),
+        ("profile", profile),
+    ]:
+        if val is not None:
+            _config[key] = val
+    _configured = True
+    logger.info(f"activation checkpointing configured: {_config}")
+
+
+def is_configured() -> bool:
+    return _configured
+
+
+def get_partition_activations() -> bool:
+    return _config["partition_activations"]
+
+
+def policy_from_name(name: Optional[str]):
+    """Resolve a policy knob to a jax.checkpoint policy callable."""
+    if name is None or name == "default":
+        return None
+    attr = _POLICIES.get(name, name)
+    if attr is None:
+        return None
+    pol = getattr(jax.checkpoint_policies, attr, None)
+    if pol is None:
+        logger.warning(f"unknown remat policy {name!r}; saving nothing")
+    return pol
+
+
+def checkpoint(function: Callable, *args, policy: Optional[str] = None, **kwargs) -> Any:
+    """Rematerialized call (reference ``checkpoint`` :954): activations
+    inside ``function`` are recomputed during backward instead of stored."""
+    wrapped = jax.checkpoint(
+        function, policy=policy_from_name(policy), prevent_cse=False
+    )
+    return wrapped(*args, **kwargs)
+
+
+def checkpoint_wrapper(function: Callable, policy: Optional[str] = None) -> Callable:
+    """Decorator form: returns a remat'd version of ``function``."""
+    return jax.checkpoint(function, policy=policy_from_name(policy), prevent_cse=False)
+
+
+class CheckpointFunction:
+    """API-parity shim for the reference's autograd.Function (:475): calling
+    ``CheckpointFunction.apply(run_fn, *args)`` remats ``run_fn``."""
+
+    @staticmethod
+    def apply(run_function: Callable, *args) -> Any:
+        return checkpoint(run_function, *args)
+
+
+def model_parallel_cuda_manual_seed(seed: int) -> None:  # noqa: ARG001
+    """No-op parity shim (reference :320): jax PRNG keys are explicit values,
+    so there is no global RNG state to fork per mp rank."""
+
+
+def reset() -> None:
+    """Reset between configs (reference ``reset`` :1040)."""
+    global _configured
+    _configured = False

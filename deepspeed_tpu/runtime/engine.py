@@ -154,6 +154,14 @@ class DeepSpeedEngine:
             logging_fn=lambda msg: log_dist(msg, ranks=[0]),
         )
 
+        # flops profiler (reference engine.py:574-598 wiring) -------------
+        self.flops_profiler = None
+        self._last_profile_args = None
+        if self._config.flops_profiler_config.enabled:
+            from deepspeed_tpu.profiling.flops_profiler.profiler import FlopsProfiler
+
+            self.flops_profiler = FlopsProfiler(ds_engine=self)
+
         # monitor --------------------------------------------------------
         self.monitor = None
         if self._config.monitor_config.enabled:
@@ -589,12 +597,42 @@ class DeepSpeedEngine:
         self.tput_timer.start()
         placed = self._place_batch(batch)
         self._rng, step_rng = jax.random.split(self._rng)
+        profiling = (
+            self.flops_profiler is not None
+            and self.global_steps == self._config.flops_profiler_config.profile_step
+            and self._training_mode
+            # only the first microbatch of the profile step (global_steps is
+            # constant across a gradient-accumulation window)
+            and self.micro_steps % self.gradient_accumulation_steps() == 0
+        )
+        if profiling:
+            self.flops_profiler.start_profile()
         if self._training_mode:
-            loss, self._grad_acc = self._jit_fwd_bwd(
-                self._params, self._grad_acc, self._scale_state.scale, step_rng, placed
-            )
+            fwd_args = (self._params, self._grad_acc, self._scale_state.scale, step_rng, placed)
+            if profiling:
+                # abstract shapes only: grad_acc is donated by the call below
+                self._last_profile_args = jax.tree_util.tree_map(
+                    lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
+                    if hasattr(x, "shape")
+                    else x,
+                    fwd_args,
+                )
+            loss, self._grad_acc = self._jit_fwd_bwd(*fwd_args)
             self._last_loss = loss
             self._in_forward = True
+            if profiling:
+                jax.device_get(loss)  # close the latency window at step end
+                pcfg = self._config.flops_profiler_config
+                self.flops_profiler.stop_profile()
+                self.flops_profiler.print_model_profile(
+                    profile_step=pcfg.profile_step,
+                    module_depth=pcfg.module_depth,
+                    top_modules=pcfg.top_modules,
+                    detailed=pcfg.detailed,
+                    output_file=pcfg.output_file,
+                )
+                self.flops_profiler.end_profile()
+                self._last_profile_args = None
         else:
             loss = self._jit_eval(self._params, step_rng, placed)
             self._last_loss = loss

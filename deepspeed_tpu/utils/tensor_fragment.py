@@ -1,0 +1,209 @@
+"""Tensor-fragment access API.
+
+Counterpart of ``deepspeed/utils/tensor_fragment.py``: regardless of how
+ZeRO sharded the state, users can read/write the full fp32 master weight,
+optimizer state, and gradient of any named parameter. The reference maps
+flat-partition fragments back per rank (``safe_get_full_fp32_param`` :92);
+here the shardings are declarative, so "full view" is a gather
+(``device_get`` of the global array) and "set" is a resharded ``device_put``.
+
+Addressing: parameters are named by their pytree path, ``/``-joined
+(e.g. ``"layers/wq"``); ``engine.parameter_names()`` lists them.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _flatten_with_paths(tree) -> Dict[str, Any]:
+    """Path → leaf in jax ``tree_flatten`` order (dict keys SORTED — this
+    must match ``tree_leaves`` so positional indexing into per-leaf state
+    like ``HostOffloadAdam._shards`` stays aligned)."""
+    out: Dict[str, Any] = {}
+
+    def walk(prefix, t):
+        if isinstance(t, dict):
+            for k in sorted(t.keys()):
+                walk(f"{prefix}/{k}" if prefix else str(k), t[k])
+        elif isinstance(t, (list, tuple)):
+            for i, v in enumerate(t):
+                walk(f"{prefix}/{i}" if prefix else str(i), v)
+        else:
+            out[prefix] = t
+
+    walk("", tree)
+    return out
+
+
+def _set_in_tree(tree, path: str, value) -> bool:
+    keys = path.split("/")
+    node = tree
+    for k in keys[:-1]:
+        node = node[int(k)] if isinstance(node, (list, tuple)) else node[k]
+    last = keys[-1]
+    if isinstance(node, tuple):
+        return False  # immutable container: caller reports failure
+    if isinstance(node, list):
+        node[int(last)] = value
+    else:
+        node[last] = value
+    return True
+
+
+def parameter_names(engine) -> List[str]:
+    """All addressable parameter paths."""
+    return list(_flatten_with_paths(engine.get_params()).keys())
+
+
+def safe_get_full_fp32_param(engine, name: str) -> Optional[np.ndarray]:
+    """Full fp32 master weight of ``name`` (reference :92)."""
+    master = engine.get_master_params()
+    if master is None:
+        return None
+    flat = _flatten_with_paths(master)
+    if name not in flat:
+        return None
+    return np.asarray(jax.device_get(flat[name]), dtype=np.float32)
+
+
+def safe_set_full_fp32_param(engine, name: str, value) -> bool:
+    """Overwrite the master weight (and the live compute param) of ``name``
+    (reference ``safe_set_full_fp32_param``)."""
+    value = np.asarray(value, dtype=np.float32)
+    if engine._host_offload is not None:
+        leaves_paths = list(_flatten_with_paths(engine.get_params()).keys())
+        if name not in leaves_paths:
+            return False
+        li = leaves_paths.index(name)
+        ho = engine._host_offload
+        for sh in ho._shards[li]:
+            sh.master[:] = value[sh.index].reshape(-1)
+        _refresh_param_from_master(engine, name, value)
+        return True
+    master = engine._master
+    if master is None:
+        return False
+    flat = _flatten_with_paths(master)
+    if name not in flat:
+        return False
+    old = flat[name]
+    new = jax.device_put(jnp.asarray(value, jnp.float32), old.sharding)
+    if not _set_in_tree(master, name, new):
+        return False
+    _refresh_param_from_master(engine, name, value)
+    return True
+
+
+def _refresh_param_from_master(engine, name: str, value: np.ndarray) -> None:
+    params = engine._params
+    flat = _flatten_with_paths(params)
+    if name in flat:
+        old = flat[name]
+        new = jax.device_put(jnp.asarray(value).astype(old.dtype), old.sharding)
+        _set_in_tree(params, name, new)
+
+
+def safe_get_full_grad(engine, name: str) -> Optional[np.ndarray]:
+    """Full (accumulated) gradient of ``name`` (reference
+    ``safe_get_full_grad``). Note grads are scaled by loss-scale × gas until
+    the step consumes them."""
+    if engine._grad_acc is None:
+        return None
+    flat = _flatten_with_paths(engine._grad_acc)
+    if name not in flat:
+        return None
+    return np.asarray(jax.device_get(flat[name]), dtype=np.float32)
+
+
+_STATE_ALIASES = {
+    "exp_avg": ("exp_avg", "m", "mu"),
+    "exp_avg_sq": ("exp_avg_sq", "v", "nu"),
+}
+
+
+def _resolve_state_key(state_key: str) -> Optional[str]:
+    """Canonical host-offload state name for torch-style aliases; None when
+    the key names no Adam state (mirrors the non-offload alias lookup)."""
+    for canonical, aliases in _STATE_ALIASES.items():
+        if state_key == canonical or state_key in aliases:
+            return canonical
+    return None
+
+
+def safe_get_full_optimizer_state(engine, name: str, state_key: str) -> Optional[np.ndarray]:
+    """Full optimizer state tensor for ``name`` (reference
+    ``safe_get_full_optimizer_state``): ``state_key`` in
+    {exp_avg, exp_avg_sq} (torch names; mapped onto the functional state)."""
+    if engine._host_offload is not None:
+        key = _resolve_state_key(state_key)
+        if key is None:
+            return None
+        ho = engine._host_offload
+        paths = list(_flatten_with_paths(engine.get_params()).keys())
+        if name not in paths:
+            return None
+        li = paths.index(name)
+        sd = ho.state_dict()
+        recs = sd["leaves"][li]
+        full = np.zeros(ho._shapes[li], np.float32)
+        for sh, rec in zip(ho._shards[li], recs):
+            from deepspeed_tpu.runtime.zero.offload_states import _index_shape
+
+            full[sh.index] = np.asarray(rec[key]).reshape(_index_shape(sh.index, ho._shapes[li]))
+        return full
+    opt_state = engine._opt_state
+    if opt_state is None:
+        return None
+    aliases = _STATE_ALIASES.get(state_key, (state_key,))
+    for field in getattr(opt_state, "_fields", []):
+        if field in aliases or state_key == field:
+            tree = getattr(opt_state, field)
+            flat = _flatten_with_paths(tree)
+            if name in flat:
+                return np.asarray(jax.device_get(flat[name]), dtype=np.float32)
+    return None
+
+
+def safe_set_full_optimizer_state(engine, name: str, state_key: str, value) -> bool:
+    value = np.asarray(value, dtype=np.float32)
+    if engine._host_offload is not None:
+        key = _resolve_state_key(state_key)
+        if key is None:
+            return False
+        ho = engine._host_offload
+        paths = list(_flatten_with_paths(engine.get_params()).keys())
+        if name not in paths:
+            return False
+        li = paths.index(name)
+        if ho.swapper is not None:
+            for sh in ho._shards[li]:
+                m = np.empty_like(sh.master)
+                v = np.empty_like(sh.master)
+                ho.swapper.fetch_param(sh.param_id, {"exp_avg": m, "exp_avg_sq": v})
+                tgt = {"exp_avg": m, "exp_avg_sq": v}
+                tgt[key][:] = value[sh.index].reshape(-1)
+                ho.swapper.swap_out_param(sh.param_id, tgt)
+        else:
+            for sh in ho._shards[li]:
+                arr = sh.exp_avg if key == "exp_avg" else sh.exp_avg_sq
+                arr[:] = value[sh.index].reshape(-1)
+        return True
+    opt_state = engine._opt_state
+    if opt_state is None:
+        return False
+    aliases = _STATE_ALIASES.get(state_key, (state_key,))
+    for field in getattr(opt_state, "_fields", []):
+        if field in aliases or state_key == field:
+            tree = getattr(opt_state, field)
+            flat = _flatten_with_paths(tree)
+            if name not in flat:
+                return False
+            old = flat[name]
+            _set_in_tree(tree, name, jax.device_put(jnp.asarray(value, jnp.float32), old.sharding))
+            return True
+    return False

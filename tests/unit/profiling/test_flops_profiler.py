@@ -1,0 +1,93 @@
+"""Flops profiler tests (reference: ``tests/unit/profiling/``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+import deepspeed_tpu.parallel.mesh as mesh_mod
+from deepspeed_tpu.profiling.flops_profiler import get_model_profile
+from deepspeed_tpu.profiling.flops_profiler.profiler import get_compiled_cost
+from tests.unit.simple_model import SimpleModel
+
+
+class TestCostAnalysis:
+    def test_matmul_flops(self):
+        n = 128
+
+        def f(a, b):
+            return a @ b
+
+        a = jnp.ones((n, n), jnp.float32)
+        b = jnp.ones((n, n), jnp.float32)
+        cost = get_compiled_cost(jax.jit(f), a, b)
+        # 2*n^3 fma flops, allow fusion slack
+        assert cost["flops"] >= 2 * n**3 * 0.9
+
+    def test_get_model_profile(self, capsys):
+        def f(x):
+            return jnp.tanh(x @ x.T).sum()
+
+        flops, macs, params = get_model_profile(
+            f, input_shape=(64, 64), print_profile=True, as_string=False
+        )
+        assert flops > 0
+        out = capsys.readouterr().out
+        assert "flops=" in out
+
+
+class TestEngineProfiler:
+    def test_profile_step_prints(self, capsys):
+        mesh_mod.reset_topology()
+        cfg = {
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "sgd", "params": {"lr": 0.01}},
+            "flops_profiler": {"enabled": True, "profile_step": 1},
+            "steps_per_print": 100,
+        }
+        model = SimpleModel(hidden_dim=16)
+        engine, _, _, _ = ds.initialize(model=model, config=cfg, dist_init_required=False)
+        rs = np.random.RandomState(0)
+        batch = (rs.randn(8, 16).astype(np.float32), rs.randn(8, 16).astype(np.float32))
+        for _ in range(3):
+            loss = engine(batch)
+            engine.backward(loss)
+            engine.step()
+        out = capsys.readouterr().out
+        assert "DeepSpeed Flops Profiler" in out
+        assert "Compiled step flops" in out
+
+
+class TestActivationCheckpointing:
+    def test_checkpoint_matches_uncheckpointed(self):
+        from deepspeed_tpu.runtime.activation_checkpointing import checkpointing
+
+        def f(w, x):
+            return jnp.sum(jnp.tanh(x @ w) ** 2)
+
+        w = jnp.ones((8, 8)) * 0.3
+        x = jnp.ones((4, 8))
+        g_plain = jax.grad(f)(w, x)
+        g_remat = jax.grad(lambda w, x: checkpointing.checkpoint(f, w, x))(w, x)
+        np.testing.assert_allclose(np.asarray(g_plain), np.asarray(g_remat), rtol=1e-6)
+
+    def test_configure_roundtrip(self):
+        from deepspeed_tpu.runtime.activation_checkpointing import checkpointing
+
+        checkpointing.reset()
+        assert not checkpointing.is_configured()
+        checkpointing.configure(partition_activations=True, checkpoint_in_cpu=False)
+        assert checkpointing.is_configured()
+        assert checkpointing.get_partition_activations()
+        checkpointing.reset()
+
+    def test_checkpoint_function_shim(self):
+        from deepspeed_tpu.runtime.activation_checkpointing.checkpointing import (
+            CheckpointFunction,
+        )
+
+        out = CheckpointFunction.apply(lambda a, b: a + b, jnp.ones(3), jnp.ones(3))
+        np.testing.assert_array_equal(np.asarray(out), np.full(3, 2.0))
