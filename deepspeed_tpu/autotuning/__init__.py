@@ -1,0 +1,9 @@
+"""Autotuning (reference: ``deepspeed/autotuning/``)."""
+
+from deepspeed_tpu.autotuning.autotuner import (
+    Autotuner,
+    GridSearchTuner,
+    ModelBasedTuner,
+    RandomTuner,
+    run_autotuning,
+)

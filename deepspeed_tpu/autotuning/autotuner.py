@@ -1,0 +1,248 @@
+"""Autotuner.
+
+Counterpart of the reference's ``Autotuner``
+(``deepspeed/autotuning/autotuner.py:42``): profile the model, derive which
+ZeRO stages fit memory (``get_instantiation_memory_required_per_gpu``
+reference :278), generate a candidate-config grid, run short trials, pick
+the best by throughput/latency (``autotuning_metric``).
+
+TPU deltas: trials run in-process (one jit cache per trial; the reference
+schedules separate jobs because CUDA state is poisoned per process — XLA
+recompiles cleanly), and memory feasibility uses the analytic ZeRO
+estimator plus the compiled step's own memory analysis when available.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random as _random
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.runtime.zero.partition import estimate_zero_memory
+from deepspeed_tpu.utils.logging import logger
+
+DEFAULT_MICRO_BATCHES = [1, 2, 4, 8, 16]
+DEFAULT_STAGES = [0, 1, 2, 3]
+
+AUTOTUNING_METRIC_THROUGHPUT = "throughput"
+AUTOTUNING_METRIC_LATENCY = "latency"
+
+
+class BaseTuner:
+    """(reference autotuning/tuner/base_tuner.py)"""
+
+    def __init__(self, exps: List[Dict]):
+        self.all_exps = list(exps)
+
+    def next_batch(self, sample_size: int) -> List[Dict]:
+        raise NotImplementedError
+
+    def has_next(self) -> bool:
+        return bool(self.all_exps)
+
+
+class GridSearchTuner(BaseTuner):
+    """Exhaustive order (reference tuner/index_based_tuner.py)."""
+
+    def next_batch(self, sample_size: int) -> List[Dict]:
+        batch = self.all_exps[:sample_size]
+        self.all_exps = self.all_exps[sample_size:]
+        return batch
+
+
+class RandomTuner(BaseTuner):
+    """Random order (reference tuner/index_based_tuner.py RandomTuner)."""
+
+    def __init__(self, exps: List[Dict], seed: int = 0):
+        super().__init__(exps)
+        _random.Random(seed).shuffle(self.all_exps)
+
+    def next_batch(self, sample_size: int) -> List[Dict]:
+        batch = self.all_exps[:sample_size]
+        self.all_exps = self.all_exps[sample_size:]
+        return batch
+
+
+class ModelBasedTuner(BaseTuner):
+    """Cost-model-guided order (reference tuner/model_based_tuner.py):
+    candidates sorted by predicted per-chip memory headroom (larger micro
+    batches first among feasible — the throughput prior)."""
+
+    def __init__(self, exps: List[Dict], hbm_bytes: int, n_params: int, dp: int):
+        def score(exp):
+            zc = exp["zero_optimization"]["stage"]
+            mem = estimate_zero_memory(n_params, zc, dp)["total_bytes"]
+            headroom = hbm_bytes - mem
+            return (headroom < 0, -exp["train_micro_batch_size_per_gpu"], zc)
+
+        super().__init__(sorted(exps, key=score))
+
+    def next_batch(self, sample_size: int) -> List[Dict]:
+        batch = self.all_exps[:sample_size]
+        self.all_exps = self.all_exps[sample_size:]
+        return batch
+
+
+class Autotuner:
+    def __init__(
+        self,
+        model_factory: Callable[[], Any],
+        base_config: Dict,
+        batch_factory: Callable[[int], Any],
+        micro_batches: Optional[List[int]] = None,
+        stages: Optional[List[int]] = None,
+        metric: str = AUTOTUNING_METRIC_THROUGHPUT,
+        tuner_type: str = "gridsearch",
+        trial_steps: int = 5,
+        warmup_steps: int = 2,
+        max_trials: int = 50,
+        hbm_bytes: int = 16 * 2**30,
+    ):
+        self.model_factory = model_factory
+        self.base_config = dict(base_config)
+        self.batch_factory = batch_factory
+        self.micro_batches = micro_batches or DEFAULT_MICRO_BATCHES
+        self.stages = stages or DEFAULT_STAGES
+        self.metric = metric
+        self.tuner_type = tuner_type
+        self.trial_steps = trial_steps
+        self.warmup_steps = warmup_steps
+        self.max_trials = max_trials
+        self.hbm_bytes = hbm_bytes
+        self.results: List[Dict] = []
+
+    # --- model info (reference model_info_profile_run :663) ---------------
+    def model_info(self) -> Dict[str, Any]:
+        import jax
+
+        model = self.model_factory()
+        batch = self.batch_factory(1)
+        shapes = jax.eval_shape(
+            lambda r, b: model.init(r, b) if hasattr(model, "init") else model[0](r, b),
+            jax.random.PRNGKey(0),
+            batch,
+        )
+        n = sum(int(np.prod(s.shape)) for s in jax.tree_util.tree_leaves(shapes))
+        return {"num_params": n}
+
+    # --- candidate grid ---------------------------------------------------
+    def generate_experiments(self) -> List[Dict]:
+        info = self.model_info()
+        n_params = info["num_params"]
+        import jax
+
+        dp = len(jax.devices())
+        exps = []
+        for stage, micro in itertools.product(self.stages, self.micro_batches):
+            mem = estimate_zero_memory(n_params, stage, dp)["total_bytes"]
+            if mem > self.hbm_bytes:
+                logger.debug(f"skip stage={stage} (needs {mem/2**30:.1f} GiB)")
+                continue
+            cfg = dict(self.base_config)
+            cfg["train_micro_batch_size_per_gpu"] = micro
+            cfg["zero_optimization"] = dict(cfg.get("zero_optimization", {}), stage=stage)
+            cfg.pop("train_batch_size", None)
+            exps.append(cfg)
+        return exps
+
+    def _make_tuner(self, exps: List[Dict]) -> BaseTuner:
+        if self.tuner_type == "random":
+            return RandomTuner(exps)
+        if self.tuner_type == "model_based":
+            import jax
+
+            info = self.model_info()
+            return ModelBasedTuner(
+                exps, self.hbm_bytes, info["num_params"], len(jax.devices())
+            )
+        return GridSearchTuner(exps)
+
+    # --- trials -----------------------------------------------------------
+    def run_trial(self, config: Dict) -> Optional[Dict]:
+        import jax
+
+        import deepspeed_tpu as ds
+        import deepspeed_tpu.parallel.mesh as mesh_mod
+
+        mesh_mod.reset_topology()
+        micro = config["train_micro_batch_size_per_gpu"]
+        try:
+            engine, _, _, _ = ds.initialize(
+                model=self.model_factory(), config=config, dist_init_required=False
+            )
+            batch = self.batch_factory(micro * engine.data_parallel_world_size())
+            for _ in range(self.warmup_steps):
+                loss = engine(batch)
+                engine.backward(loss)
+                engine.step()
+            jax.device_get(loss)
+            t0 = time.perf_counter()
+            for _ in range(self.trial_steps):
+                loss = engine(batch)
+                engine.backward(loss)
+                engine.step()
+            jax.device_get(loss)
+            dt = (time.perf_counter() - t0) / self.trial_steps
+        except Exception as e:
+            logger.warning(f"trial failed for {config.get('zero_optimization')}, mb={micro}: {e}")
+            return None
+        samples_per_sec = micro * engine.data_parallel_world_size() / dt
+        return {
+            "config": config,
+            "latency_s": dt,
+            "throughput_samples_per_s": samples_per_sec,
+        }
+
+    def tune(self) -> Optional[Dict]:
+        exps = self.generate_experiments()
+        logger.info(f"autotuning over {len(exps)} candidate configs")
+        tuner = self._make_tuner(exps)
+        trials = 0
+        while tuner.has_next() and trials < self.max_trials:
+            for config in tuner.next_batch(1):
+                result = self.run_trial(config)
+                trials += 1
+                if result is not None:
+                    self.results.append(result)
+        if not self.results:
+            return None
+        if self.metric == AUTOTUNING_METRIC_LATENCY:
+            best = min(self.results, key=lambda r: r["latency_s"])
+        else:
+            best = max(self.results, key=lambda r: r["throughput_samples_per_s"])
+        logger.info(
+            f"autotuning best: stage={best['config']['zero_optimization']['stage']} "
+            f"micro={best['config']['train_micro_batch_size_per_gpu']} "
+            f"({best['throughput_samples_per_s']:.1f} samples/s)"
+        )
+        return best
+
+
+def run_autotuning(args) -> int:
+    """CLI entry (reference runner.py:360): the user script is expected to
+    define ``model_factory``/``batch_factory``/``base_config``; exec it and
+    tune."""
+    namespace: Dict[str, Any] = {}
+    with open(args.user_script) as f:
+        code = f.read()
+    exec(compile(code, args.user_script, "exec"), namespace)  # noqa: S102
+    required = ("model_factory", "batch_factory", "base_config")
+    if not all(k in namespace for k in required):
+        raise RuntimeError(
+            f"--autotuning requires the script to define {required} "
+            "(see deepspeed_tpu.autotuning.Autotuner)"
+        )
+    tuner = Autotuner(
+        namespace["model_factory"], namespace["base_config"], namespace["batch_factory"]
+    )
+    best = tuner.tune()
+    if best is None:
+        print("autotuning: no feasible config found")
+        return 1
+    import json
+
+    print(json.dumps(best["config"], indent=2, default=str))
+    return 0

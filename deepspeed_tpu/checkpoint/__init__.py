@@ -1,0 +1,14 @@
+"""Universal checkpointing (reference: ``deepspeed/checkpoint/``)."""
+
+from deepspeed_tpu.checkpoint.deepspeed_checkpoint import (
+    DeepSpeedCheckpoint,
+    convert_to_universal,
+    load_hp_checkpoint_state,
+    universal_param_names,
+)
+from deepspeed_tpu.checkpoint.reshape_utils import (
+    ReshapeMeg2D,
+    merge_tp_slices,
+    reshape_tp_degree,
+    split_tp_slices,
+)

@@ -1,0 +1,14 @@
+"""Compression (reference: ``deepspeed/compression/``)."""
+
+from deepspeed_tpu.compression.compress import (
+    init_compression,
+    redundancy_clean,
+    student_initialization,
+)
+from deepspeed_tpu.compression.basic_layer import (
+    head_pruning_mask,
+    quantize_activation,
+    quantize_weight,
+    row_pruning_mask,
+    sparse_pruning_mask,
+)

@@ -1,0 +1,84 @@
+"""Compression primitives.
+
+Counterpart of the reference's ``deepspeed/compression/basic_layer.py``
+(``LinearLayer_Compress`` :121 and friends). The reference swaps nn.Modules
+for compressed variants; with functional params the same transforms are
+pure functions applied to weight leaves inside the forward:
+
+* weight/activation quantization-aware training → ``fake_quantize`` with a
+  straight-through gradient (``ops/quantizer``);
+* sparse / row / column / head pruning → masks derived from weight magnitude
+  at a configured ratio, applied multiplicatively.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.quantizer import fake_quantize
+
+
+def quantize_weight(w: jnp.ndarray, bits: int = 8, num_groups: int = 1) -> jnp.ndarray:
+    """QAT weight transform (reference ``weight_quantization``)."""
+    groups = num_groups
+    if w.size % groups != 0:
+        groups = 1
+    return fake_quantize(w, num_groups=groups, num_bits=bits)
+
+
+def quantize_activation(x: jnp.ndarray, bits: int = 8) -> jnp.ndarray:
+    """QAT activation transform (reference ``activation_quantization``);
+    per-tensor (one group per leading index) to keep scales cheap."""
+    groups = x.shape[0] if x.ndim > 1 else 1
+    return fake_quantize(x, num_groups=groups, num_bits=bits)
+
+
+def sparse_pruning_mask(w: jnp.ndarray, ratio: float, method: str = "l1") -> jnp.ndarray:
+    """Unstructured magnitude mask keeping the top (1-ratio) fraction
+    (reference ``sparse_pruning`` with method l1/topk)."""
+    if method not in ("l1", "topk"):
+        raise ValueError(f"unsupported sparse pruning method {method!r}")
+    k = max(1, int(round(w.size * (1.0 - ratio))))
+    flat = jnp.abs(w).reshape(-1)
+    threshold = jnp.sort(flat)[-k]
+    return (jnp.abs(w) >= threshold).astype(w.dtype)
+
+
+def row_pruning_mask(w: jnp.ndarray, ratio: float) -> jnp.ndarray:
+    """Structured row mask by row L1 norm (reference ``row_pruning``);
+    rows = output features of a [in, out] matmul weight → mask dim -1."""
+    norms = jnp.sum(jnp.abs(w), axis=tuple(range(w.ndim - 1)))
+    k = max(1, int(round(norms.shape[0] * (1.0 - ratio))))
+    threshold = jnp.sort(norms)[-k]
+    mask = (norms >= threshold).astype(w.dtype)
+    return jnp.broadcast_to(mask, w.shape)
+
+
+def channel_pruning_mask(w: jnp.ndarray, ratio: float) -> jnp.ndarray:
+    """Structured input-channel mask (reference ``channel_pruning``):
+    mask dim -2 (input features)."""
+    norms = jnp.sum(jnp.abs(w), axis=tuple(i for i in range(w.ndim) if i != w.ndim - 2))
+    k = max(1, int(round(norms.shape[0] * (1.0 - ratio))))
+    threshold = jnp.sort(norms)[-k]
+    return jnp.broadcast_to(mask_expand(mask := (norms >= threshold).astype(w.dtype), w.ndim, w.ndim - 2), w.shape)
+
+
+def head_pruning_mask(w: jnp.ndarray, ratio: float, num_heads: int) -> jnp.ndarray:
+    """Attention-head mask on an output-projection weight [NH*D, H]
+    (reference ``head_pruning``): per-head L1 over the input dim."""
+    in_dim = w.shape[0]
+    head_dim = in_dim // num_heads
+    per_head = jnp.sum(jnp.abs(w.reshape(num_heads, head_dim, -1)), axis=(1, 2))
+    k = max(1, int(round(num_heads * (1.0 - ratio))))
+    threshold = jnp.sort(per_head)[-k]
+    head_mask = (per_head >= threshold).astype(w.dtype)
+    return jnp.repeat(head_mask, head_dim)[:, None] * jnp.ones_like(w)
+
+
+def mask_expand(mask: jnp.ndarray, ndim: int, axis: int) -> jnp.ndarray:
+    shape = [1] * ndim
+    shape[axis] = mask.shape[0]
+    return mask.reshape(shape)

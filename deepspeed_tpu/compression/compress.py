@@ -1,0 +1,175 @@
+"""Compression entry points.
+
+Counterpart of the reference's ``deepspeed/compression/compress.py``
+(``init_compression`` :100, ``redundancy_clean`` :148,
+``student_initialization`` :192). Functional translation:
+
+* ``init_compression(model, config)`` wraps a DSModule so the configured
+  transforms (QAT weight quantization, pruning masks) apply to matching
+  param leaves during every forward — training sees compressed weights,
+  gradients flow straight-through;
+* ``redundancy_clean(params, config)`` bakes the masks/quantization into the
+  stored parameters (the reference's post-training cleanup);
+* module matching uses the reference's config shape: per-method blocks with
+  ``modules`` name patterns (here: path regexes over the param tree).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.compression.basic_layer import (
+    head_pruning_mask,
+    quantize_weight,
+    row_pruning_mask,
+    sparse_pruning_mask,
+)
+from deepspeed_tpu.runtime.module import DSModule, wrap_module
+from deepspeed_tpu.utils.logging import logger
+
+WEIGHT_QUANTIZATION = "weight_quantization"
+ACTIVATION_QUANTIZATION = "activation_quantization"
+SPARSE_PRUNING = "sparse_pruning"
+ROW_PRUNING = "row_pruning"
+HEAD_PRUNING = "head_pruning"
+CHANNEL_PRUNING = "channel_pruning"
+LAYER_REDUCTION = "layer_reduction"
+
+SHARED_PARAMETERS = "shared_parameters"
+DIFFERENT_GROUPS = "different_groups"
+
+
+def _method_specs(compression_config: Dict) -> List[Tuple[str, Dict, List[str]]]:
+    """Flatten the reference's nested config into
+    (method, params, module_patterns) rows."""
+    rows = []
+    for method in (WEIGHT_QUANTIZATION, SPARSE_PRUNING, ROW_PRUNING, HEAD_PRUNING, CHANNEL_PRUNING):
+        block = compression_config.get(method)
+        if not block:
+            continue
+        shared = block.get(SHARED_PARAMETERS, {})
+        if not shared.get("enabled", False):
+            continue
+        for group_name, group in block.get(DIFFERENT_GROUPS, {}).items():
+            params = dict(shared)
+            params.update(group.get("params", {}))
+            modules = group.get("modules", ["*"])
+            rows.append((method, params, modules))
+    return rows
+
+
+def _pattern_to_regex(pat: str) -> str:
+    return "^" + re.escape(pat).replace(r"\*", ".*") + "$"
+
+
+def _matches(path: str, patterns: List[str]) -> bool:
+    return any(re.match(_pattern_to_regex(p), path) for p in patterns)
+
+
+def _transform_leaf(method: str, params: Dict, w: jnp.ndarray) -> jnp.ndarray:
+    if method == WEIGHT_QUANTIZATION:
+        bits = params.get("start_bits", params.get("quantize_weight_in_forward", 8))
+        if isinstance(bits, bool):
+            bits = 8
+        return quantize_weight(w, bits=int(bits), num_groups=int(params.get("quantize_groups", 1)))
+    if method == SPARSE_PRUNING:
+        return w * sparse_pruning_mask(w, float(params.get("dense_ratio", 0.5)))
+    if method == ROW_PRUNING:
+        return w * row_pruning_mask(w, float(params.get("dense_ratio", 0.5)))
+    if method == CHANNEL_PRUNING:
+        from deepspeed_tpu.compression.basic_layer import channel_pruning_mask
+
+        return w * channel_pruning_mask(w, float(params.get("dense_ratio", 0.5)))
+    if method == HEAD_PRUNING:
+        return w * head_pruning_mask(
+            w, float(params.get("dense_ratio", 0.5)), int(params.get("num_heads", 1))
+        )
+    return w
+
+
+class CompressedModule(DSModule):
+    """DSModule wrapper applying compression transforms each forward."""
+
+    def __init__(self, inner: DSModule, compression_config: Dict):
+        self.inner = inner
+        self.rows = _method_specs(compression_config)
+        self.enabled_methods = {m for m, _, _ in self.rows}
+        logger.info(
+            f"init_compression: {len(self.rows)} group(s), methods={sorted(self.enabled_methods)}"
+        )
+
+    def _compress(self, params):
+        def walk(prefix, tree):
+            if isinstance(tree, dict):
+                return {k: walk(f"{prefix}/{k}" if prefix else k, v) for k, v in tree.items()}
+            if isinstance(tree, (list, tuple)):
+                return type(tree)(walk(f"{prefix}/{i}", v) for i, v in enumerate(tree))
+            w = tree
+            if jnp.ndim(w) < 2:
+                return w  # biases/norms stay exact (reference behavior)
+            for method, p, patterns in self.rows:
+                if _matches(prefix, patterns):
+                    w = _transform_leaf(method, p, w)
+            return w
+
+        return walk("", params)
+
+    def init(self, rng, batch):
+        return self.inner.init(rng, batch)
+
+    def apply(self, params, batch, *, rngs=None, train: bool = True):
+        return self.inner.apply(self._compress(params), batch, rngs=rngs, train=train)
+
+    def tp_partition_rules(self, params_shapes=None):
+        return self.inner.tp_partition_rules(params_shapes)
+
+    def keep_fp32_params(self, params_shapes=None):
+        return self.inner.keep_fp32_params(params_shapes)
+
+
+def init_compression(model, deepspeed_config, teacher_model=None, mpu=None) -> DSModule:  # noqa: ARG001
+    """(reference compress.py:100) Wrap the model so compression applies in
+    the forward; pass the wrapped module to ``deepspeed.initialize``."""
+    cfg = deepspeed_config
+    if hasattr(cfg, "compression_config"):
+        cfg = cfg.compression_config
+    elif isinstance(cfg, dict):
+        cfg = cfg.get("compression_training", cfg)
+    module = wrap_module(model)
+    return CompressedModule(module, cfg or {})
+
+
+def redundancy_clean(params, deepspeed_config, mpu=None):  # noqa: ARG001
+    """(reference compress.py:148) Bake the transforms into stored params —
+    after this the plain (unwrapped) module reproduces compressed outputs."""
+    cfg = deepspeed_config
+    if isinstance(cfg, dict):
+        cfg = cfg.get("compression_training", cfg)
+    shim = CompressedModule(wrap_module(_IdentityModule()), cfg or {})
+    return shim._compress(params)
+
+
+def student_initialization(student_params, teacher_params, deepspeed_config):  # noqa: ARG001
+    """(reference compress.py:192) Layer-reduction init: copy matching
+    teacher leaves into the student tree where shapes agree."""
+
+    def walk(s, t):
+        if isinstance(s, dict):
+            return {k: walk(s[k], t.get(k, s[k])) if isinstance(t, dict) else s[k] for k in s}
+        if hasattr(s, "shape") and hasattr(t, "shape") and s.shape == t.shape:
+            return t
+        return s
+
+    return walk(student_params, teacher_params)
+
+
+class _IdentityModule(DSModule):
+    def init(self, rng, batch):  # noqa: ARG002
+        return {}
+
+    def apply(self, params, batch, *, rngs=None, train=True):  # noqa: ARG002
+        return batch

@@ -1,0 +1,113 @@
+"""Block quantization ops.
+
+Counterpart of the reference's quantization kernels (``csrc/quantization/``:
+``quantize.cu``/``dequantize.cu``/``swizzled_quantize.cu``/``quant_reduce.cu``,
+bindings pt_binding.cpp:228-251). On TPU these are jnp expressions fused by
+XLA into the surrounding collectives — symmetric and asymmetric block
+quantization to int8/int4, used by the ZeRO++ quantized collectives
+(``runtime/comm/coalesced_collectives.py``) and QAT (``compression/``).
+
+Layout: a flat tensor is viewed as [num_groups, group_size]; each group
+carries its own scale (and min for asymmetric). int4 values occupy the low
+nibble of an int8 (TPU has no packed-int4 array type at this layer; the
+wire format stays int8 — bandwidth parity with int4 packing is handled by
+the collectives packing two nibbles per byte when requested).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _grouped(x: jnp.ndarray, num_groups: int) -> jnp.ndarray:
+    flat = x.reshape(-1)
+    assert flat.shape[0] % num_groups == 0, (
+        f"{flat.shape[0]} elements not divisible into {num_groups} groups"
+    )
+    return flat.reshape(num_groups, -1)
+
+
+def quantize(x: jnp.ndarray, num_groups: int, num_bits: int = 8) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric per-group quantization (reference ``ds_quantize_*``).
+
+    Returns (q [num_groups, group_size] int8, scales [num_groups] f32).
+    """
+    g = _grouped(x, num_groups).astype(jnp.float32)
+    qmax = float(2 ** (num_bits - 1) - 1)
+    absmax = jnp.max(jnp.abs(g), axis=1, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / qmax, 1.0)
+    q = jnp.clip(jnp.round(g / scale), -qmax - 1, qmax).astype(jnp.int8)
+    return q, scale[:, 0]
+
+
+def dequantize(q: jnp.ndarray, scale: jnp.ndarray, shape=None, dtype=jnp.float32) -> jnp.ndarray:
+    out = q.astype(jnp.float32) * scale[:, None]
+    if shape is not None:
+        out = out.reshape(shape)
+    return out.astype(dtype)
+
+
+def quantize_asymmetric(
+    x: jnp.ndarray, num_groups: int, num_bits: int = 8
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Asymmetric per-group quantization (min/scale), as the reference's
+    activation quantizer uses. Returns (q uint-coded int8, scale, minv)."""
+    g = _grouped(x, num_groups).astype(jnp.float32)
+    levels = float(2**num_bits - 1)
+    minv = jnp.min(g, axis=1, keepdims=True)
+    maxv = jnp.max(g, axis=1, keepdims=True)
+    scale = jnp.where(maxv > minv, (maxv - minv) / levels, 1.0)
+    q = jnp.clip(jnp.round((g - minv) / scale), 0, levels).astype(
+        jnp.uint16 if num_bits > 8 else jnp.uint8
+    )
+    return q, scale[:, 0], minv[:, 0]
+
+
+def dequantize_asymmetric(q, scale, minv, shape=None, dtype=jnp.float32):
+    out = q.astype(jnp.float32) * scale[:, None] + minv[:, None]
+    if shape is not None:
+        out = out.reshape(shape)
+    return out.astype(dtype)
+
+
+def fake_quantize(x: jnp.ndarray, num_groups: int, num_bits: int = 8) -> jnp.ndarray:
+    """Quantize-dequantize roundtrip with a straight-through gradient —
+    the reference's ``fake_quantizer.cu`` for QAT."""
+
+    @jax.custom_vjp
+    def _fq(x):
+        q, s = quantize(x, num_groups, num_bits)
+        return dequantize(q, s, shape=x.shape, dtype=x.dtype)
+
+    def fwd(x):
+        return _fq(x), None
+
+    def bwd(_, g):
+        return (g,)  # straight-through estimator
+
+    _fq.defvjp(fwd, bwd)
+    return _fq(x)
+
+
+def swizzle_quant(x: jnp.ndarray, num_groups: int, num_bits: int = 8):
+    """Parity shim for the reference's ``swizzled_quantize`` — the swizzle
+    reorders groups for GPU warp-coalesced access; XLA chooses its own
+    layouts, so this is plain quantize."""
+    return quantize(x, num_groups, num_bits)
+
+
+class Quantizer:
+    """Object API used by compression/eigenvalue code paths."""
+
+    def __init__(self, q_bits: int = 8, q_groups: int = 1):
+        self.q_bits = q_bits
+        self.q_groups = q_groups
+
+    def quantize(self, x):
+        return quantize(x, self.q_groups, self.q_bits)
+
+    def dequantize(self, q, scale, shape=None, dtype=jnp.float32):
+        return dequantize(q, scale, shape, dtype)

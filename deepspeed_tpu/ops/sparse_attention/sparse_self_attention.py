@@ -1,0 +1,190 @@
+"""Block-sparse self-attention.
+
+Counterpart of the reference's Triton block-sparse kernels
+(``deepspeed/ops/sparse_attention/``: ``SparseSelfAttention``,
+``MatMul``/``Softmax`` on block layouts, triton sources ``trsrc/*.tr``) and
+the C++ layout utils (``csrc/sparse_attention/utils.cpp``).
+
+TPU implementation: the block layout gathers only the LIVE kv blocks per
+query block (dense gather → [rows, max_live, block, d]) so compute and
+memory scale with the number of live blocks, not seq² — the same work-
+skipping the Triton kernel gets from its block pointers, expressed in
+XLA-friendly dense gathers (static shapes, MXU-shaped einsums). Numerics are
+exact attention over the unmasked pairs (softmax in fp32 over live blocks
+with per-element masking).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+    DenseSparsityConfig,
+    SparsityConfig,
+)
+
+
+def _layout_gather_indices(layout_h: np.ndarray):
+    """Per query-block row: indices of live kv blocks, padded to the max
+    row population (padding marked dead)."""
+    num_blocks = layout_h.shape[0]
+    live = [np.nonzero(layout_h[r])[0] for r in range(num_blocks)]
+    max_live = max((len(l) for l in live), default=1)
+    max_live = max(max_live, 1)
+    idx = np.zeros((num_blocks, max_live), dtype=np.int32)
+    mask = np.zeros((num_blocks, max_live), dtype=bool)
+    for r, l in enumerate(live):
+        idx[r, : len(l)] = l
+        mask[r, : len(l)] = True
+    return idx, mask
+
+
+def block_sparse_attention(
+    q: jnp.ndarray,  # [B, NH, T, D]
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    layout: np.ndarray,  # [NH or 1, T/block, T/block]
+    block: int,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    key_padding_mask: Optional[jnp.ndarray] = None,  # [B, T], True = keep
+) -> jnp.ndarray:
+    B, NH, T, D = q.shape
+    nb = T // block
+    scale = scale if scale is not None else 1.0 / np.sqrt(D)
+    shared_layout = layout.shape[0] == 1
+
+    def one_head_group(qh, kh, vh, layout_h, kp_mask):
+        # qh: [Bh, T, D] for one head (or heads folded into batch when the
+        # layout is shared); Bh = B or B*NH
+        Bh = qh.shape[0]
+        idx, live_mask = _layout_gather_indices(layout_h)
+        max_live = idx.shape[1]
+        qb = qh.reshape(Bh, nb, block, D)
+        kb = kh.reshape(Bh, nb, block, D)
+        vb = vh.reshape(Bh, nb, block, D)
+        # gather live kv blocks per query row: [B, nb, max_live, block, D]
+        kg = kb[:, idx]
+        vg = vb[:, idx]
+        scores = (
+            jnp.einsum("brqd,brlkd->brqlk", qb, kg).astype(jnp.float32) * scale
+        )  # [Bh, nb, block, max_live, block]
+        # masks: dead blocks, causal within pairs, key padding
+        neg = jnp.float32(-1e30)
+        mask = jnp.asarray(live_mask)[None, :, None, :, None]
+        if causal:
+            q_pos = (
+                jnp.arange(nb)[:, None] * block + jnp.arange(block)[None, :]
+            )  # [nb, block]
+            k_pos = (
+                jnp.asarray(idx)[:, :, None] * block + jnp.arange(block)[None, None, :]
+            )  # [nb, max_live, block]
+            causal_mask = q_pos[:, :, None, None] >= k_pos[:, None, :, :]
+            mask = mask & causal_mask[None]
+        if kp_mask is not None:
+            kp = kp_mask.reshape(Bh, nb, block)  # [Bh, nb_k, block]
+            kp_g = kp[:, idx]  # [Bh, nb, max_live, block]
+            mask = mask & kp_g[:, :, None, :, :]
+        scores = jnp.where(mask, scores, neg)
+        flat = scores.reshape(Bh, nb, block, max_live * block)
+        probs = jax.nn.softmax(flat, axis=-1)
+        # rows with no live keys (padded causal heads) -> zero out
+        any_live = jnp.any(
+            jnp.broadcast_to(mask, scores.shape).reshape(Bh, nb, block, -1),
+            axis=-1, keepdims=True,
+        )
+        probs = jnp.where(any_live, probs, 0.0).astype(vh.dtype)
+        probs = probs.reshape(Bh, nb, block, max_live, block)
+        out = jnp.einsum("brqlk,brlkd->brqd", probs, vg)
+        return out.reshape(Bh, T, D)
+
+    if shared_layout:
+        # fold heads into batch: one gather pattern for all heads
+        qf = q.reshape(B * NH, T, D)
+        kf = k.reshape(B * NH, T, D)
+        vf = v.reshape(B * NH, T, D)
+        kp = (
+            jnp.repeat(key_padding_mask, NH, axis=0)
+            if key_padding_mask is not None
+            else None
+        )
+        out = one_head_group(qf, kf, vf, layout[0], kp)
+        return out.reshape(B, NH, T, D)
+    outs = [
+        one_head_group(q[:, h], k[:, h], v[:, h], layout[h], key_padding_mask)
+        for h in range(NH)
+    ]
+    return jnp.stack(outs, axis=1)
+
+
+class SparseSelfAttention:
+    """Reference ``SparseSelfAttention`` module surface: config-driven
+    layout, q/k/v in [B, NH, T, D]."""
+
+    def __init__(
+        self,
+        sparsity_config: SparsityConfig = None,
+        key_padding_mask_mode: str = "add",  # noqa: ARG002 - parity
+        attn_mask_mode: str = "mul",  # noqa: ARG002
+        max_seq_length: int = 2048,
+    ):
+        self.sparsity_config = sparsity_config or DenseSparsityConfig(num_heads=4)
+        self.max_seq_length = max_seq_length
+        self._layouts = {}
+
+    def get_layout(self, seq_len: int) -> np.ndarray:
+        if seq_len not in self._layouts:
+            self._layouts[seq_len] = self.sparsity_config.make_layout(seq_len)
+        return self._layouts[seq_len]
+
+    def __call__(self, query, key, value, key_padding_mask=None, attn_mask=None):  # noqa: ARG002
+        T = query.shape[2]
+        layout = self.get_layout(T)
+        causal = getattr(self.sparsity_config, "attention", "bidirectional") == "unidirectional"
+        if not self.sparsity_config.different_layout_per_head:
+            layout = layout[:1]
+        if key_padding_mask is not None and key_padding_mask.dtype != jnp.bool_:
+            key_padding_mask = key_padding_mask > 0
+        return block_sparse_attention(
+            query,
+            key,
+            value,
+            layout,
+            self.sparsity_config.block,
+            causal=causal,
+            key_padding_mask=key_padding_mask,
+        )
+
+
+class BertSparseSelfAttention:
+    """Reference ``BertSparseSelfAttention``: fused qkv projection around
+    SparseSelfAttention for BERT-shaped inputs [B, T, H]."""
+
+    def __init__(self, config, sparsity_config=None):
+        self.num_heads = config.num_attention_heads
+        self.head_dim = config.hidden_size // config.num_attention_heads
+        self.sparse = SparseSelfAttention(
+            sparsity_config or FixedDefault(self.num_heads)
+        )
+
+    def __call__(self, hidden, wq, wk, wv, attention_mask=None):
+        B, T, H = hidden.shape
+
+        def split(x):
+            return x.reshape(B, T, self.num_heads, self.head_dim).transpose(0, 2, 1, 3)
+
+        q = split(hidden @ wq)
+        k = split(hidden @ wk)
+        v = split(hidden @ wv)
+        out = self.sparse(q, k, v, key_padding_mask=attention_mask)
+        return out.transpose(0, 2, 1, 3).reshape(B, T, H)
+
+
+def FixedDefault(num_heads: int):
+    from deepspeed_tpu.ops.sparse_attention.sparsity_config import FixedSparsityConfig
+
+    return FixedSparsityConfig(num_heads=num_heads)

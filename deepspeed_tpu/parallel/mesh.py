@@ -28,7 +28,7 @@ from deepspeed_tpu.runtime.config import MeshConfig
 from deepspeed_tpu.utils.logging import logger
 
 # canonical axis order, outermost first
-AXIS_ORDER: Tuple[str, ...] = ("pipe", "data", "expert", "sequence", "model")
+AXIS_ORDER: Tuple[str, ...] = ("pipe", "data_outer", "data", "expert", "sequence", "model")
 
 _TOPOLOGY: Optional["Topology"] = None
 
@@ -42,8 +42,8 @@ class Topology:
 
     # --- world sizes (reference groups.py accessors) -------------------
     def get_data_parallel_world_size(self) -> int:
-        """Dense DP world = data × expert (the expert_data group)."""
-        return self.config.data * self.config.expert
+        """Dense DP world = data_outer × data × expert (the expert_data group)."""
+        return self.config.data_outer * self.config.data * self.config.expert
 
     def get_expert_parallel_world_size(self) -> int:
         return self.config.expert
@@ -65,7 +65,10 @@ class Topology:
 
     @property
     def world_size(self) -> int:
-        return int(np.prod([self.config.pipe, self.config.data, self.config.expert, self.config.sequence, self.config.model]))
+        return int(np.prod([
+            self.config.pipe, self.config.data_outer, self.config.data,
+            self.config.expert, self.config.sequence, self.config.model,
+        ]))
 
     # --- axis-name groups ----------------------------------------------
     @property
@@ -74,6 +77,8 @@ class Topology:
         sequence shard sees a slice of the batch's tokens, so grads reduce over
         seq too — mirroring the reference's seq_data group, engine.py:1111)."""
         axes = ["data"]
+        if self.config.data_outer > 1:
+            axes.insert(0, "data_outer")
         if self.config.expert > 1:
             axes.append("expert")
         if self.config.sequence > 1:
@@ -82,8 +87,11 @@ class Topology:
 
     @property
     def zero_shard_axes(self) -> Tuple[str, ...]:
-        """Axes ZeRO partitions params/opt-state over (= dense DP axes)."""
-        return self.data_parallel_axes
+        """Axes ZeRO partitions params/opt-state over: the dense DP axes
+        MINUS the MiCS replication axis — with data_outer > 1, state shards
+        only within each sub-group and replicates across groups
+        (reference mics.py shard-group semantics)."""
+        return tuple(a for a in self.data_parallel_axes if a != "data_outer")
 
     @property
     def expert_parallel_axis(self) -> str:
@@ -108,7 +116,7 @@ class Topology:
         """Mesh axes the batch's leading dim is sharded over, normalized to
         None | str | tuple — the single source for batch PartitionSpec entries
         (used by the engine's batch placement and the SP attention specs)."""
-        axes = tuple(a for a in ("data", "expert") if self.axis_size(a) > 1)
+        axes = tuple(a for a in ("data_outer", "data", "expert") if self.axis_size(a) > 1)
         if not axes:
             return None
         if len(axes) == 1:
@@ -133,7 +141,14 @@ def build_mesh(
         devices = jax.devices()
     n = len(devices)
     resolved = mesh_config.resolve(n)
-    shape = (resolved.pipe, resolved.data, resolved.expert, resolved.sequence, resolved.model)
+    shape = (
+        resolved.pipe,
+        resolved.data_outer,
+        resolved.data,
+        resolved.expert,
+        resolved.sequence,
+        resolved.model,
+    )
     try:
         dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
     except Exception as e:  # fallback: row-major reshape (CPU meshes, odd shapes)

@@ -111,18 +111,37 @@ def policy_from_name(name: Optional[str]):
     return pol
 
 
+def _configured_policy():
+    """Policy implied by configure()'s knobs when no explicit policy is
+    given: cpu_checkpointing → host-offload the saved residuals (when this
+    jax exposes an offload policy); otherwise save nothing (max remat)."""
+    if _config["cpu_checkpointing"]:
+        offload = getattr(jax.checkpoint_policies, "offload_dot_products_to_host", None)
+        if offload is None:
+            offload = getattr(jax.checkpoint_policies, "save_and_offload_only_these_names", None)
+            offload = None if offload is None else None  # name-based: needs user names
+        if offload is not None:
+            return offload
+        logger.warning(
+            "cpu_checkpointing requested but this jax has no host-offload remat "
+            "policy; falling back to full recomputation (nothing saved)"
+        )
+    return None
+
+
 def checkpoint(function: Callable, *args, policy: Optional[str] = None, **kwargs) -> Any:
     """Rematerialized call (reference ``checkpoint`` :954): activations
-    inside ``function`` are recomputed during backward instead of stored."""
-    wrapped = jax.checkpoint(
-        function, policy=policy_from_name(policy), prevent_cse=False
-    )
+    inside ``function`` are recomputed during backward instead of stored.
+    With no explicit ``policy``, configure()'s knobs choose one."""
+    pol = policy_from_name(policy) if policy is not None else _configured_policy()
+    wrapped = jax.checkpoint(function, policy=pol, prevent_cse=False)
     return wrapped(*args, **kwargs)
 
 
 def checkpoint_wrapper(function: Callable, policy: Optional[str] = None) -> Callable:
     """Decorator form: returns a remat'd version of ``function``."""
-    return jax.checkpoint(function, policy=policy_from_name(policy), prevent_cse=False)
+    pol = policy_from_name(policy) if policy is not None else _configured_policy()
+    return jax.checkpoint(function, policy=pol, prevent_cse=False)
 
 
 class CheckpointFunction:

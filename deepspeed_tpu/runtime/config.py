@@ -66,23 +66,34 @@ class MeshConfig(DeepSpeedConfigModel):
     """
 
     data: int = 0
+    # MiCS replication axis: ZeRO shards over `data` only and replicates
+    # across `data_outer` groups (reference deepspeed/runtime/zero/mics.py —
+    # shard groups smaller than world). Total DP = data_outer × data.
+    data_outer: int = 1
     model: int = 1
     sequence: int = 1
     expert: int = 1
     pipe: int = 1
 
     def resolve(self, n_devices: int) -> "MeshConfig":
-        fixed = self.model * self.sequence * self.expert * self.pipe
+        fixed = self.model * self.sequence * self.expert * self.pipe * self.data_outer
         if fixed <= 0 or n_devices % fixed != 0:
             raise DeepSpeedConfigError(
-                f"mesh axes model×sequence×expert×pipe={fixed} do not divide device count {n_devices}"
+                f"mesh axes data_outer×model×sequence×expert×pipe={fixed} do not divide device count {n_devices}"
             )
         data = self.data or n_devices // fixed
         if data * fixed != n_devices:
             raise DeepSpeedConfigError(
                 f"mesh {data}×{fixed} != device count {n_devices}"
             )
-        return MeshConfig(data=data, model=self.model, sequence=self.sequence, expert=self.expert, pipe=self.pipe)
+        return MeshConfig(
+            data=data,
+            data_outer=self.data_outer,
+            model=self.model,
+            sequence=self.sequence,
+            expert=self.expert,
+            pipe=self.pipe,
+        )
 
 
 class CommsLoggerConfig(DeepSpeedConfigModel):

@@ -1,0 +1,11 @@
+"""Data-efficiency pipeline (reference: ``deepspeed/runtime/data_pipeline/``)."""
+
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import CurriculumScheduler
+from deepspeed_tpu.runtime.data_pipeline.data_routing import (
+    RandomLayerTokenDrop,
+    RandomLTDScheduler,
+)
+from deepspeed_tpu.runtime.data_pipeline.data_sampler import (
+    DeepSpeedDataSampler,
+    DistributedSampler,
+)

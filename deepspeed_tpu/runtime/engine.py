@@ -63,7 +63,12 @@ from deepspeed_tpu.utils.timer import (
 
 MEMORY_OPT_ALLREDUCE_SIZE = 500_000_000  # parity: engine.py:105
 
+from deepspeed_tpu.runtime.fp16.onebit import OnebitAdam, OnebitLamb, ZeroOneAdam
+
 _OPTIMIZER_REGISTRY = {
+    C.ONEBIT_ADAM_OPTIMIZER: OnebitAdam,
+    C.ONEBIT_LAMB_OPTIMIZER: OnebitLamb,
+    C.ZERO_ONE_ADAM_OPTIMIZER: ZeroOneAdam,
     # reference parity: "adam" selects FusedAdam whose adam_w_mode defaults
     # True (decoupled decay), engine.py:1233 + ops/adam/fused_adam.py
     C.ADAM_OPTIMIZER: FusedAdam,
@@ -103,6 +108,7 @@ class DeepSpeedEngine:
         self.mpu = mpu
 
         self._config = config_class or DeepSpeedConfig(config if config is not None else {}, mpu)
+        self._apply_mics_mesh()
         self.topology: Topology = get_topology() if _topology_matches(self._config) else initialize_topology(
             self._config.mesh_config
         )
@@ -153,6 +159,17 @@ class DeepSpeedEngine:
             steps_per_output=self._config.steps_per_print,
             logging_fn=lambda msg: log_dist(msg, ranks=[0]),
         )
+
+        # curriculum learning (reference engine.py:1779-1782 seqlen kwarg;
+        # here: per-step truncation of the batch's sequence dim) ----------
+        self.curriculum_scheduler = None
+        cl_cfg = self._config.curriculum_learning_config
+        if cl_cfg and cl_cfg.get("enabled", False):
+            from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import (
+                CurriculumScheduler,
+            )
+
+            self.curriculum_scheduler = CurriculumScheduler(cl_cfg)
 
         # flops profiler (reference engine.py:574-598 wiring) -------------
         self.flops_profiler = None
@@ -595,6 +612,9 @@ class DeepSpeedEngine:
             self.init_params(batch)
         self.timers(FORWARD_GLOBAL_TIMER).start()
         self.tput_timer.start()
+        if self.curriculum_scheduler is not None and self._training_mode:
+            seqlen = self.curriculum_scheduler.update_difficulty(self.global_steps + 1)
+            batch = _truncate_seq(batch, seqlen)
         placed = self._place_batch(batch)
         self._rng, step_rng = jax.random.split(self._rng)
         profiling = (
@@ -661,6 +681,43 @@ class DeepSpeedEngine:
         self.global_samples += self.train_micro_batch_size_per_gpu() * self.data_parallel_world_size()
         self.timers(STEP_GLOBAL_TIMER).stop(sync=False)
         self.tput_timer.stop(global_step=boundary)
+
+    def _apply_mics_mesh(self) -> None:
+        """Map zero_optimization.mics_shard_size onto the mesh's MiCS split:
+        ZeRO shards within groups of that size (the 'data' axis) and
+        replicates across groups ('data_outer')."""
+        mics = self._config.zero_config.mics_shard_size
+        if mics is None or mics <= 0:
+            return
+        mc = self._config.mesh_config
+        if mc.data_outer > 1:
+            return  # user already split the axis explicitly
+        n = len(jax.devices())
+        fixed = mc.model * mc.sequence * mc.expert * mc.pipe
+        data_total = mc.data or (n // fixed)
+        # ZeRO shards over data AND expert/sequence (zero_shard_axes); the
+        # configured group size counts ALL of those ranks, so the data-axis
+        # split is mics / (expert × sequence)
+        inner_fixed = mc.expert * mc.sequence
+        if mics % inner_fixed != 0:
+            raise ValueError(
+                f"mics_shard_size={mics} must be a multiple of expert×sequence={inner_fixed} "
+                "(those axes are always inside the shard group)"
+            )
+        data_inner = mics // inner_fixed
+        if data_inner <= 0 or data_total % data_inner != 0:
+            raise ValueError(
+                f"mics_shard_size={mics} (data slice {data_inner}) does not divide "
+                f"the data axis {data_total}"
+            )
+        mc.data = data_inner
+        mc.data_outer = data_total // data_inner
+        log_dist(
+            f"MiCS: ZeRO shard groups of {mics} rank(s) "
+            f"(data {data_inner} × expert {mc.expert} × sequence {mc.sequence}), "
+            f"replicated over {mc.data_outer} groups",
+            ranks=[0],
+        )
 
     def _offload_enabled(self) -> bool:
         off = self._config.zero_config.offload_optimizer
@@ -945,6 +1002,17 @@ class DeepSpeedEngine:
             return 0
         tree = self._params if self._master is None else self._master
         return sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(tree))
+
+
+def _truncate_seq(batch, seqlen: int):
+    """Truncate every rank-≥2 leaf's dim 1 to ``seqlen`` (curriculum)."""
+
+    def leaf(x):
+        if np.ndim(x) >= 2 and np.shape(x)[1] > seqlen:
+            return x[:, :seqlen]
+        return x
+
+    return jax.tree_util.tree_map(leaf, batch)
 
 
 def _namedtuple_to_dict(nt):

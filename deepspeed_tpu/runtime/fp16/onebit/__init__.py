@@ -1,0 +1,6 @@
+"""1-bit communication-compressed optimizers
+(reference: ``deepspeed/runtime/fp16/onebit/``)."""
+
+from deepspeed_tpu.runtime.fp16.onebit.adam import OnebitAdam
+from deepspeed_tpu.runtime.fp16.onebit.lamb import OnebitLamb
+from deepspeed_tpu.runtime.fp16.onebit.zoadam import ZeroOneAdam

@@ -1,0 +1,134 @@
+"""1-bit LAMB (reference: ``deepspeed/runtime/fp16/onebit/lamb.py``).
+
+LAMB's layerwise trust ratio (‖w‖/‖update‖) composed with 1-bit momentum
+compression after ``freeze_step``: during warmup exact LAMB runs and a
+running *scaling coefficient* per tensor is recorded; in the compression
+stage the frozen variance + recorded coefficients reconstruct the layerwise
+scale for the sign-compressed momentum (the reference's
+``compensated_momentum`` + ``scaling_coeff`` machinery).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.optimizer import DSOptimizer
+
+
+class OnebitLambState(NamedTuple):
+    step: Any
+    exp_avg: Any
+    exp_avg_sq: Any
+    worker_error: Any
+    scaling_coeff: Any  # per-leaf scalar recorded during warmup
+
+
+class OnebitLamb(DSOptimizer):
+    def __init__(
+        self,
+        params=None,  # noqa: ARG002
+        deepspeed=None,  # noqa: ARG002
+        lr: float = 1e-3,
+        freeze_step: int = 100000,
+        bias_correction: bool = True,
+        betas: Tuple[float, float] = (0.9, 0.999),
+        eps: float = 1e-8,
+        weight_decay: float = 0.0,
+        max_coeff: float = 10.0,
+        min_coeff: float = 0.01,
+        amsgrad: bool = False,
+        cuda_aware: bool = False,  # noqa: ARG002
+        comm_backend_name: str = "xla",  # noqa: ARG002
+        coeff_beta: float = 0.9,
+        factor_max: float = 4.0,  # noqa: ARG002 - parity
+        factor_min: float = 0.5,  # noqa: ARG002
+        factor_threshold: float = 0.1,  # noqa: ARG002
+    ):
+        if amsgrad:
+            raise ValueError("1-bit LAMB does not support amsgrad")
+        super().__init__(lr=lr, weight_decay=weight_decay, betas=betas, eps=eps)
+        self.freeze_step = freeze_step
+        self.bias_correction = bias_correction
+        self.max_coeff = max_coeff
+        self.min_coeff = min_coeff
+        self.coeff_beta = coeff_beta
+
+    def init_state(self, params: Any) -> OnebitLambState:
+        z = lambda: jax.tree_util.tree_map(
+            lambda p: jnp.zeros(jnp.shape(p), jnp.float32), params
+        )
+        ones = jax.tree_util.tree_map(lambda p: jnp.ones((), jnp.float32), params)
+        return OnebitLambState(
+            step=jnp.zeros((), jnp.int32),
+            exp_avg=z(),
+            exp_avg_sq=z(),
+            worker_error=z(),
+            scaling_coeff=ones,
+        )
+
+    def state_specs(self, param_specs: Any) -> OnebitLambState:
+        from jax.sharding import PartitionSpec
+
+        scalar = jax.tree_util.tree_map(
+            lambda s: PartitionSpec(),
+            param_specs,
+            is_leaf=lambda x: isinstance(x, PartitionSpec),
+        )
+        return OnebitLambState(
+            step=PartitionSpec(),
+            exp_avg=param_specs,
+            exp_avg_sq=param_specs,
+            worker_error=param_specs,
+            scaling_coeff=scalar,
+        )
+
+    def apply(self, grads, state: OnebitLambState, params, lr) -> Tuple[Any, OnebitLambState]:
+        beta1, beta2 = self.defaults["betas"]
+        eps = self.defaults["eps"]
+        wd = self.defaults["weight_decay"]
+        step = state.step + 1
+        stepf = step.astype(jnp.float32)
+        compressed = stepf > float(self.freeze_step)
+        bc1 = 1.0 - beta1**stepf if self.bias_correction else jnp.float32(1.0)
+        bc2 = 1.0 - beta2**stepf if self.bias_correction else jnp.float32(1.0)
+
+        def leaf(p, g, m, v, err, coeff):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            m_new = beta1 * m + (1.0 - beta1) * g
+            v_new = jnp.where(compressed, v, beta2 * v + (1.0 - beta2) * g * g)
+
+            comm = m_new + err
+            scale = jnp.mean(jnp.abs(comm))
+            m_comp = jnp.sign(comm) * scale
+            err_new = jnp.where(compressed, comm - m_comp, jnp.zeros_like(err))
+            m_used = jnp.where(compressed, m_comp, m_new)
+
+            update = (m_used / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+            if wd:
+                update = update + wd * p32
+            w_norm = jnp.linalg.norm(p32)
+            u_norm = jnp.linalg.norm(update)
+            raw = jnp.where(u_norm > 0, w_norm / jnp.maximum(u_norm, 1e-12), 1.0)
+            trust = jnp.clip(raw, self.min_coeff, self.max_coeff)
+            trust = jnp.where(w_norm > 0, trust, 1.0)
+            # warmup records an EMA of the trust ratio; compression freezes it
+            coeff_new = jnp.where(
+                compressed, coeff, self.coeff_beta * coeff + (1 - self.coeff_beta) * trust
+            )
+            eff = jnp.where(compressed, coeff, trust)
+            return (p32 - lr * eff * update).astype(p.dtype), m_used, v_new, err_new, coeff_new
+
+        flat_p, treedef = jax.tree_util.tree_flatten(params)
+        cols = [
+            treedef.flatten_up_to(t)
+            for t in (grads, state.exp_avg, state.exp_avg_sq, state.worker_error, state.scaling_coeff)
+        ]
+        out = [leaf(p, *vals) for p, *vals in zip(flat_p, *cols)]
+        unf = lambda i: treedef.unflatten([o[i] for o in out])
+        return unf(0), OnebitLambState(
+            step=step, exp_avg=unf(1), exp_avg_sq=unf(2), worker_error=unf(3), scaling_coeff=unf(4)
+        )

@@ -27,6 +27,8 @@ def _flatten_with_paths(tree) -> Dict[str, Any]:
     out: Dict[str, Any] = {}
 
     def walk(prefix, t):
+        if t is None:
+            return  # tree_flatten drops None subtrees; stay aligned
         if isinstance(t, dict):
             for k in sorted(t.keys()):
                 walk(f"{prefix}/{k}" if prefix else str(k), t[k])
@@ -204,6 +206,7 @@ def safe_set_full_optimizer_state(engine, name: str, state_key: str, value) -> b
             if name not in flat:
                 return False
             old = flat[name]
-            _set_in_tree(tree, name, jax.device_put(jnp.asarray(value, jnp.float32), old.sharding))
-            return True
+            return _set_in_tree(
+                tree, name, jax.device_put(jnp.asarray(value, jnp.float32), old.sharding)
+            )
     return False

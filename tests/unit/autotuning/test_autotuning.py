@@ -1,0 +1,76 @@
+"""Autotuner tests (reference: ``tests/unit/autotuning/test_autotuning.py``)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.autotuning import Autotuner, GridSearchTuner, RandomTuner
+from tests.unit.simple_model import SimpleModel
+
+
+def _batch_factory(n):
+    rs = np.random.RandomState(0)
+    return (rs.randn(n, 16).astype(np.float32), rs.randn(n, 16).astype(np.float32))
+
+
+BASE = {
+    "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+    "steps_per_print": 1000,
+}
+
+
+def _tuner(**kw):
+    return Autotuner(
+        model_factory=lambda: SimpleModel(hidden_dim=16),
+        base_config=BASE,
+        batch_factory=_batch_factory,
+        micro_batches=kw.pop("micro_batches", [1, 2]),
+        stages=kw.pop("stages", [0, 1]),
+        trial_steps=2,
+        warmup_steps=1,
+        **kw,
+    )
+
+
+class TestTuners:
+    def test_grid_exhausts_in_order(self):
+        exps = [{"i": i} for i in range(5)]
+        t = GridSearchTuner(exps)
+        seen = []
+        while t.has_next():
+            seen += t.next_batch(2)
+        assert [e["i"] for e in seen] == [0, 1, 2, 3, 4]
+
+    def test_random_is_permutation(self):
+        exps = [{"i": i} for i in range(10)]
+        t = RandomTuner(exps, seed=1)
+        seen = []
+        while t.has_next():
+            seen += t.next_batch(3)
+        assert sorted(e["i"] for e in seen) == list(range(10))
+
+
+class TestAutotuner:
+    def test_model_info(self):
+        info = _tuner().model_info()
+        assert info["num_params"] == 2 * 16 * 16
+
+    def test_generate_experiments_grid(self):
+        exps = _tuner().generate_experiments()
+        assert len(exps) == 4  # 2 stages × 2 micro batches
+        combos = {
+            (e["zero_optimization"]["stage"], e["train_micro_batch_size_per_gpu"])
+            for e in exps
+        }
+        assert combos == {(0, 1), (0, 2), (1, 1), (1, 2)}
+
+    def test_memory_filter(self):
+        t = _tuner(hbm_bytes=10)  # nothing fits in 10 bytes
+        assert t.generate_experiments() == []
+
+    def test_tune_end_to_end(self):
+        best = _tuner().tune()
+        assert best is not None
+        assert best["throughput_samples_per_s"] > 0
+        assert best["config"]["zero_optimization"]["stage"] in (0, 1)

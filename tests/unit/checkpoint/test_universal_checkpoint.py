@@ -1,0 +1,106 @@
+"""Universal checkpoint tests (reference: ``tests/unit/checkpoint/``)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+import deepspeed_tpu.parallel.mesh as mesh_mod
+from deepspeed_tpu.checkpoint import (
+    DeepSpeedCheckpoint,
+    convert_to_universal,
+    load_hp_checkpoint_state,
+    merge_tp_slices,
+    reshape_tp_degree,
+    split_tp_slices,
+    universal_param_names,
+)
+from tests.unit.simple_model import SimpleModel
+
+
+class TestReshapeUtils:
+    def test_split_merge_roundtrip(self):
+        w = np.arange(64).reshape(8, 8).astype(np.float32)
+        shards = split_tp_slices(w, 4, axis=1)
+        assert all(s.shape == (8, 2) for s in shards)
+        np.testing.assert_array_equal(merge_tp_slices(shards, axis=1), w)
+
+    def test_reshape_degree(self):
+        w = np.arange(64).reshape(8, 8).astype(np.float32)
+        old = split_tp_slices(w, 4, axis=0)
+        new = reshape_tp_degree(old, 4, 2, axis=0)
+        assert len(new) == 2 and new[0].shape == (4, 8)
+        np.testing.assert_array_equal(merge_tp_slices(new, axis=0), w)
+
+    def test_indivisible_raises(self):
+        with pytest.raises(ValueError):
+            split_tp_slices(np.zeros((6, 6)), 4, axis=0)
+
+
+def _make_ckpt(tmp_path, zero_stage=2):
+    mesh_mod.reset_topology()
+    cfg = {
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": zero_stage},
+        "steps_per_print": 100,
+    }
+    engine, _, _, _ = ds.initialize(
+        model=SimpleModel(hidden_dim=16), config=cfg, dist_init_required=False
+    )
+    rs = np.random.RandomState(0)
+    batch = (rs.randn(8, 16).astype(np.float32), rs.randn(8, 16).astype(np.float32))
+    for _ in range(2):
+        loss = engine(batch)
+        engine.backward(loss)
+        engine.step()
+    engine.save_checkpoint(str(tmp_path / "ckpt"))
+    return engine
+
+
+class TestUniversal:
+    def test_convert_and_load_fragments(self, tmp_path):
+        engine = _make_ckpt(tmp_path)
+        out = convert_to_universal(str(tmp_path / "ckpt"), str(tmp_path / "universal"))
+        assert out.endswith(".npz")
+        names = universal_param_names(str(tmp_path / "universal"))
+        assert names == ["w0", "w1"]
+        frag = load_hp_checkpoint_state(str(tmp_path / "universal"), "w0")
+        assert set(frag) == {"fp32", "exp_avg", "exp_avg_sq"}
+        from deepspeed_tpu.utils.tensor_fragment import (
+            safe_get_full_fp32_param,
+            safe_get_full_optimizer_state,
+        )
+
+        np.testing.assert_allclose(frag["fp32"], safe_get_full_fp32_param(engine, "w0"))
+        np.testing.assert_allclose(
+            frag["exp_avg"], safe_get_full_optimizer_state(engine, "w0", "exp_avg")
+        )
+
+    def test_checkpoint_inspector(self, tmp_path):
+        _make_ckpt(tmp_path)
+        ckpt = DeepSpeedCheckpoint(str(tmp_path / "ckpt"))
+        assert ckpt.get_iteration() == 2
+        assert "w0" in ckpt.get_module()
+
+    def test_missing_param_raises(self, tmp_path):
+        _make_ckpt(tmp_path)
+        convert_to_universal(str(tmp_path / "ckpt"), str(tmp_path / "universal"))
+        with pytest.raises(KeyError):
+            load_hp_checkpoint_state(str(tmp_path / "universal"), "nope")
+
+
+class TestNebulaEngine:
+    def test_async_save_roundtrip(self, tmp_path):
+        from deepspeed_tpu.runtime.checkpoint_engine.nebula_checkpoint_engine import (
+            NebulaCheckpointEngine,
+        )
+
+        eng = NebulaCheckpointEngine()
+        state = {"module": {"w": np.arange(8, dtype=np.float32)}, "global_steps": 3}
+        eng.save(state, str(tmp_path / "nebula"))
+        eng.commit("tag")  # fences the background write
+        loaded = eng.load(str(tmp_path / "nebula"))
+        np.testing.assert_array_equal(loaded["module"]["w"], state["module"]["w"])
+        assert loaded["global_steps"] == 3

@@ -1,0 +1,132 @@
+"""Compression tests (reference: ``tests/unit/compression/``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+import deepspeed_tpu.parallel.mesh as mesh_mod
+from deepspeed_tpu.compression import (
+    init_compression,
+    redundancy_clean,
+    row_pruning_mask,
+    sparse_pruning_mask,
+)
+from tests.unit.simple_model import SimpleModel
+
+
+class TestMasks:
+    def test_sparse_mask_ratio(self):
+        rs = np.random.RandomState(0)
+        w = jnp.asarray(rs.randn(32, 32).astype(np.float32))
+        mask = sparse_pruning_mask(w, ratio=0.75)
+        kept = float(np.asarray(mask).sum()) / mask.size
+        assert abs(kept - 0.25) < 0.02
+        # the kept entries are the largest-magnitude ones
+        thresh = np.sort(np.abs(np.asarray(w)).ravel())[-int(0.25 * w.size)]
+        assert (np.abs(np.asarray(w))[np.asarray(mask) > 0] >= thresh).all()
+
+    def test_row_mask_structured(self):
+        rs = np.random.RandomState(1)
+        w = jnp.asarray(rs.randn(16, 8).astype(np.float32))
+        mask = np.asarray(row_pruning_mask(w, ratio=0.5))
+        col_live = mask.all(axis=0)
+        assert set(np.unique(mask)) <= {0.0, 1.0}
+        assert col_live.sum() == 4  # half the output features survive
+        # each column is fully on or fully off
+        assert ((mask.sum(axis=0) == 16) | (mask.sum(axis=0) == 0)).all()
+
+
+COMPRESSION_CONFIG = {
+    "weight_quantization": {
+        "shared_parameters": {"enabled": True},
+        "different_groups": {
+            "wq1": {"params": {"start_bits": 8, "quantize_groups": 1}, "modules": ["w0"]}
+        },
+    },
+    "sparse_pruning": {
+        "shared_parameters": {"enabled": True},
+        "different_groups": {
+            "sp1": {"params": {"dense_ratio": 0.5}, "modules": ["w1"]}
+        },
+    },
+}
+
+
+class TestInitCompression:
+    def test_forward_uses_compressed_weights(self):
+        mesh_mod.reset_topology()
+        model = init_compression(SimpleModel(hidden_dim=16), COMPRESSION_CONFIG)
+        cfg = {
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+            "steps_per_print": 100,
+        }
+        engine, _, _, _ = ds.initialize(model=model, config=cfg, dist_init_required=False)
+        rs = np.random.RandomState(0)
+        batch = (rs.randn(8, 16).astype(np.float32), rs.randn(8, 16).astype(np.float32))
+        losses = []
+        for _ in range(5):
+            loss = engine(batch)
+            engine.backward(loss)
+            engine.step()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]  # QAT still trains (straight-through)
+
+    def test_redundancy_clean_bakes_masks(self):
+        rs = np.random.RandomState(0)
+        params = {
+            "w0": jnp.asarray(rs.randn(16, 16).astype(np.float32)),
+            "w1": jnp.asarray(rs.randn(16, 16).astype(np.float32)),
+        }
+        cleaned = redundancy_clean(params, COMPRESSION_CONFIG)
+        # w1 pruned to ~50%
+        zeros = float((np.asarray(cleaned["w1"]) == 0).mean())
+        assert abs(zeros - 0.5) < 0.05
+        # w0 quantized: at most 256 distinct values
+        assert len(np.unique(np.asarray(cleaned["w0"]))) <= 256
+
+
+class TestEigenvalue:
+    def test_quadratic_eigenvalue(self):
+        from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+
+        # loss = 0.5 x^T A x with known top eigenvalue
+        rs = np.random.RandomState(0)
+        q, _ = np.linalg.qr(rs.randn(8, 8))
+        eigs = np.array([5.0, 3, 2, 1, 0.5, 0.2, 0.1, 0.05])
+        A = jnp.asarray((q * eigs) @ q.T, dtype=jnp.float32)
+
+        def loss(p):
+            x = p["x"]
+            return 0.5 * x @ A @ x
+
+        ev = Eigenvalue(max_iter=200, tol=1e-4)
+        est = ev.compute_eigenvalue(loss, {"x": jnp.ones(8)})
+        assert abs(est - 5.0) < 0.1
+
+    def test_per_block(self):
+        from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+
+        def loss(p):
+            return 2.0 * jnp.sum(p["a"] ** 2) + 0.5 * jnp.sum(p["b"] ** 2)
+
+        ev = Eigenvalue(max_iter=50)
+        out = ev.compute_eigenvalue_per_block(loss, {"a": jnp.ones(4), "b": jnp.ones(4)})
+        assert abs(out["a"] - 4.0) < 0.1  # Hessian of 2x² is 4I
+        assert abs(out["b"] - 1.0) < 0.1
+
+
+class TestProgressiveLayerDrop:
+    def test_theta_schedule(self):
+        from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
+
+        pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+        pld.update_state(0)
+        assert pld.get_theta() == 1.0
+        pld.update_state(10**6)
+        assert abs(pld.get_theta() - 0.5) < 1e-6
+        assert pld.get_state()["progressive_layer_drop"]

@@ -1,0 +1,95 @@
+"""Quantizer + quantized collectives tests (reference:
+``tests/unit/ops/quantizer/`` + ``tests/unit/runtime/zero/test_zeropp.py``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.ops.quantizer import (
+    dequantize,
+    dequantize_asymmetric,
+    fake_quantize,
+    quantize,
+    quantize_asymmetric,
+)
+
+
+class TestQuantize:
+    @pytest.mark.parametrize("num_bits", [4, 8])
+    def test_roundtrip_error_bounded(self, num_bits):
+        rs = np.random.RandomState(0)
+        x = rs.randn(64, 32).astype(np.float32)
+        q, s = quantize(jnp.asarray(x), num_groups=16, num_bits=num_bits)
+        out = np.asarray(dequantize(q, s, shape=x.shape))
+        qmax = 2 ** (num_bits - 1) - 1
+        # per-group max-abs / qmax bounds the rounding error
+        bound = np.abs(x).max() / qmax
+        assert np.abs(out - x).max() <= bound + 1e-6
+
+    def test_zeros_stable(self):
+        q, s = quantize(jnp.zeros(64), num_groups=4)
+        assert np.all(np.asarray(q) == 0)
+        np.testing.assert_array_equal(np.asarray(dequantize(q, s)), np.zeros((4, 16)))
+
+    def test_asymmetric_roundtrip(self):
+        rs = np.random.RandomState(1)
+        x = (rs.rand(128) * 5 + 3).astype(np.float32)  # strictly positive range
+        q, s, m = quantize_asymmetric(jnp.asarray(x), num_groups=8)
+        out = np.asarray(dequantize_asymmetric(q, s, m, shape=x.shape))
+        assert np.abs(out - x).max() <= (x.max() - x.min()) / 255 + 1e-6
+
+    def test_fake_quantize_straight_through(self):
+        x = jnp.linspace(-1, 1, 64)
+        g = jax.grad(lambda x: jnp.sum(fake_quantize(x, num_groups=4) ** 2))(x)
+        # STE: gradient flows as if identity → d/dx sum(fq(x)^2) ≈ 2*fq(x)
+        fq = fake_quantize(x, num_groups=4)
+        np.testing.assert_allclose(np.asarray(g), 2 * np.asarray(fq), rtol=1e-5)
+
+
+class TestQuantizedCollectives:
+    def _mesh(self):
+        devs = jax.devices()
+        return Mesh(np.array(devs).reshape(len(devs)), ("data",))
+
+    def test_quantized_reduce_scatter_close_to_exact(self, eight_devices):  # noqa: ARG002
+        from deepspeed_tpu.runtime.comm.coalesced_collectives import (
+            quantized_reduce_scatter,
+        )
+
+        mesh = self._mesh()
+        rs = np.random.RandomState(0)
+        x = rs.randn(1024).astype(np.float32)
+        out = np.asarray(jax.device_get(quantized_reduce_scatter(jnp.asarray(x), mesh)))
+        # every chip contributed the same replicated x → exact = world * x
+        exact = len(jax.devices()) * x
+        err = np.abs(out - exact).max()
+        assert err <= np.abs(x).max() * len(jax.devices()) / 127 + 1e-5
+
+    def test_quantized_all_gather(self, eight_devices):  # noqa: ARG002
+        from deepspeed_tpu.runtime.comm.coalesced_collectives import quantized_all_gather
+
+        mesh = self._mesh()
+        rs = np.random.RandomState(1)
+        x = rs.randn(64, 16).astype(np.float32)
+        sharded = jax.device_put(jnp.asarray(x), NamedSharding(mesh, P("data")))
+        out = np.asarray(jax.device_get(quantized_all_gather(sharded, mesh)))
+        assert out.shape == x.shape
+        assert np.abs(out - x).max() <= np.abs(x).max() / 127 + 1e-5
+
+    def test_reduce_scatter_coalesced_exact(self, eight_devices):  # noqa: ARG002
+        from deepspeed_tpu.runtime.comm.coalesced_collectives import (
+            reduce_scatter_coalesced,
+        )
+
+        mesh = self._mesh()
+        rs = np.random.RandomState(2)
+        a = rs.randn(128).astype(np.float32)
+        b = rs.randn(72).astype(np.float32)
+        outs = reduce_scatter_coalesced([jnp.asarray(a), jnp.asarray(b)], mesh)
+        n = len(jax.devices())
+        np.testing.assert_allclose(np.asarray(outs[0]), n * a, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(outs[1]), n * b, rtol=1e-5)

@@ -1,0 +1,145 @@
+"""Block-sparse attention tests (reference: ``tests/unit/ops/sparse_attention/``)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.sparse_attention import (
+    BigBirdSparsityConfig,
+    BSLongformerSparsityConfig,
+    DenseSparsityConfig,
+    FixedSparsityConfig,
+    LocalSlidingWindowSparsityConfig,
+    SparseSelfAttention,
+    VariableSparsityConfig,
+    block_sparse_attention,
+)
+
+
+def _dense_reference(q, k, v, mask=None, causal=False, scale=None):
+    scale = scale or 1.0 / np.sqrt(q.shape[-1])
+    scores = np.einsum("bhtd,bhsd->bhts", q, k).astype(np.float64) * scale
+    T = q.shape[2]
+    if causal:
+        cm = np.tril(np.ones((T, T), bool))
+        scores = np.where(cm, scores, -1e30)
+    if mask is not None:
+        scores = np.where(mask[:, None, None, :], scores, -1e30)
+    p = np.exp(scores - scores.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    return np.einsum("bhts,bhsd->bhtd", p, v)
+
+
+class TestLayouts:
+    def test_dense_all_ones(self):
+        layout = DenseSparsityConfig(num_heads=2, block=8).make_layout(64)
+        assert layout.shape == (2, 8, 8)
+        assert layout.all()
+
+    def test_fixed_local_blocks(self):
+        cfg = FixedSparsityConfig(num_heads=2, block=8, num_local_blocks=2, attention="unidirectional")
+        layout = cfg.make_layout(64)
+        # diagonal always live; nothing above diagonal in causal mode
+        for r in range(8):
+            assert layout[0, r, r] == 1
+        assert np.triu(layout[0], k=1).sum() == 0
+
+    def test_bigbird_window_and_global(self):
+        cfg = BigBirdSparsityConfig(num_heads=1, block=8, num_sliding_window_blocks=3, num_global_blocks=1)
+        layout = cfg.make_layout(64)
+        assert layout[0, 0].all()  # global row
+        assert layout[0, :, 0].all()  # global col
+        for r in range(1, 8):
+            assert layout[0, r, r] == 1
+
+    def test_longformer(self):
+        cfg = BSLongformerSparsityConfig(num_heads=1, block=8, num_sliding_window_blocks=3)
+        layout = cfg.make_layout(64)
+        assert layout[0, :, 0].all() and layout[0, 0, :].all()
+
+    def test_variable(self):
+        cfg = VariableSparsityConfig(num_heads=1, block=8, local_window_blocks=[1, 2])
+        layout = cfg.make_layout(64)
+        assert layout[0].sum() > 0
+
+    def test_local_sliding(self):
+        cfg = LocalSlidingWindowSparsityConfig(num_heads=1, block=8, num_sliding_window_blocks=3)
+        layout = cfg.make_layout(64)
+        assert np.triu(layout[0], k=1).sum() == 0
+
+    def test_indivisible_raises(self):
+        with pytest.raises(ValueError):
+            DenseSparsityConfig(num_heads=1, block=16).make_layout(70)
+
+
+class TestBlockSparseAttention:
+    def _qkv(self, B=2, NH=2, T=64, D=16, seed=0):
+        rs = np.random.RandomState(seed)
+        return (
+            rs.randn(B, NH, T, D).astype(np.float32),
+            rs.randn(B, NH, T, D).astype(np.float32),
+            rs.randn(B, NH, T, D).astype(np.float32),
+        )
+
+    def test_dense_layout_matches_full_attention(self):
+        q, k, v = self._qkv()
+        layout = DenseSparsityConfig(num_heads=1, block=16).make_layout(64)[:1]
+        out = np.asarray(
+            block_sparse_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), layout, 16)
+        )
+        ref = _dense_reference(q, k, v)
+        np.testing.assert_allclose(out, ref, rtol=2e-3, atol=2e-3)
+
+    def test_causal_dense_matches(self):
+        q, k, v = self._qkv()
+        layout = DenseSparsityConfig(num_heads=1, block=16).make_layout(64)[:1]
+        out = np.asarray(
+            block_sparse_attention(
+                jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), layout, 16, causal=True
+            )
+        )
+        ref = _dense_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(out, ref, rtol=2e-3, atol=2e-3)
+
+    def test_sparse_masks_dead_blocks(self):
+        """Keys in dead blocks must not influence the output."""
+        q, k, v = self._qkv(NH=1)
+        cfg = LocalSlidingWindowSparsityConfig(num_heads=1, block=16, num_sliding_window_blocks=1)
+        layout = cfg.make_layout(64)
+        out1 = np.asarray(
+            block_sparse_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), layout, 16, causal=True)
+        )
+        # perturb keys/values OUTSIDE each row's own block: no effect
+        k2, v2 = k.copy(), v.copy()
+        k2[:, :, :16] += 100.0
+        v2[:, :, :16] -= 55.0
+        out2 = np.asarray(
+            block_sparse_attention(jnp.asarray(q), jnp.asarray(k2), jnp.asarray(v2), layout, 16, causal=True)
+        )
+        # rows in blocks >= 1 never see block 0 under a width-1 window
+        np.testing.assert_allclose(out1[:, :, 16:], out2[:, :, 16:], rtol=1e-5)
+
+    def test_module_surface(self):
+        q, k, v = self._qkv(NH=4)
+        attn = SparseSelfAttention(
+            FixedSparsityConfig(num_heads=4, block=16, num_local_blocks=2, attention="unidirectional")
+        )
+        out = attn(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        assert out.shape == q.shape
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_key_padding_mask(self):
+        q, k, v = self._qkv(NH=1)
+        mask = np.ones((2, 64), bool)
+        mask[:, 48:] = False  # padded tail
+        layout = DenseSparsityConfig(num_heads=1, block=16).make_layout(64)[:1]
+        out = np.asarray(
+            block_sparse_attention(
+                jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), layout, 16,
+                key_padding_mask=jnp.asarray(mask),
+            )
+        )
+        ref = _dense_reference(q, k, v, mask=mask)
+        np.testing.assert_allclose(out, ref, rtol=2e-3, atol=2e-3)

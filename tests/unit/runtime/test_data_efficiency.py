@@ -1,0 +1,164 @@
+"""Data-efficiency tests (reference: ``tests/unit/runtime/test_data_efficiency.py``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+import deepspeed_tpu.parallel.mesh as mesh_mod
+from deepspeed_tpu.runtime.data_pipeline import (
+    CurriculumScheduler,
+    DeepSpeedDataSampler,
+    DistributedSampler,
+    RandomLayerTokenDrop,
+    RandomLTDScheduler,
+)
+
+
+class TestCurriculumScheduler:
+    CFG = {
+        "min_difficulty": 8,
+        "max_difficulty": 64,
+        "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": 10, "difficulty_step": 8},
+    }
+
+    def test_linear_ramps(self):
+        s = CurriculumScheduler(self.CFG)
+        assert s.update_difficulty(0) == 8
+        mid = s.update_difficulty(5)
+        assert 8 < mid < 64
+        assert s.update_difficulty(10) == 64
+        assert s.update_difficulty(100) == 64
+
+    def test_difficulty_step_quantized(self):
+        s = CurriculumScheduler(self.CFG)
+        for step in range(12):
+            assert s.update_difficulty(step) % 8 == 0
+
+    def test_fixed_root(self):
+        cfg = dict(self.CFG, schedule_type="fixed_root")
+        cfg["schedule_config"] = dict(cfg["schedule_config"], root_degree=2)
+        s = CurriculumScheduler(cfg)
+        # sqrt schedule ramps faster early than linear
+        assert s.update_difficulty(3) >= CurriculumScheduler(self.CFG).update_difficulty(3)
+
+    def test_fixed_discrete(self):
+        cfg = {
+            "min_difficulty": 8,
+            "max_difficulty": 64,
+            "schedule_type": "fixed_discrete",
+            "schedule_config": {"difficulty": [8, 32, 64], "max_step": [5, 10]},
+        }
+        s = CurriculumScheduler(cfg)
+        assert s.update_difficulty(3) == 8
+        assert s.update_difficulty(7) == 32
+        assert s.update_difficulty(50) == 64
+
+    def test_bad_schedule_raises(self):
+        with pytest.raises(RuntimeError):
+            CurriculumScheduler(dict(self.CFG, schedule_type="nope"))
+
+
+class TestEngineCurriculum:
+    def test_seq_truncation(self):
+        mesh_mod.reset_topology()
+        seen_lens = []
+
+        class Probe:
+            def init(self, rng, batch):
+                return {"w": jnp.ones((1,))}
+
+            def apply(self, params, batch, rngs=None, train=True):  # noqa: ARG002
+                seen_lens.append(batch["input_ids"].shape[1])
+                return jnp.mean(batch["input_ids"].astype(jnp.float32)) * params["w"][0]
+
+        cfg = {
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "sgd", "params": {"lr": 0.0}},
+            "curriculum_learning": {
+                "enabled": True,
+                "min_difficulty": 8,
+                "max_difficulty": 32,
+                "schedule_type": "fixed_linear",
+                "schedule_config": {"total_curriculum_step": 4, "difficulty_step": 8},
+            },
+            "steps_per_print": 100,
+        }
+        engine, _, _, _ = ds.initialize(model=Probe(), config=cfg, dist_init_required=False)
+        batch = {"input_ids": np.zeros((8, 32), np.int32)}
+        for _ in range(5):
+            loss = engine(batch)
+            engine.backward(loss)
+            engine.step()
+        assert seen_lens[0] < 32  # truncated early
+        assert seen_lens[-1] == 32  # full length at the end
+
+
+class TestRandomLTD:
+    def test_scheduler_ramps(self):
+        s = RandomLTDScheduler(start_token_num=16, max_token_num=128, total_steps=10, step_size=16)
+        assert s.update(0) == 16
+        assert s.update(10) == 128
+
+    def test_token_drop_roundtrip(self):
+        from deepspeed_tpu.runtime.data_pipeline.data_routing import (
+            gather_tokens,
+            random_token_select,
+            scatter_tokens,
+        )
+
+        x = jnp.arange(2 * 16 * 4, dtype=jnp.float32).reshape(2, 16, 4)
+        idx = random_token_select(jax.random.PRNGKey(0), 16, 8, 2)
+        assert idx.shape == (2, 8)
+        # sorted → causality preserved
+        assert (np.diff(np.asarray(idx), axis=1) > 0).all()
+        sub = gather_tokens(x, idx)
+        back = scatter_tokens(x, sub * 0 + 7.0, idx)
+        for b in range(2):
+            for t in range(16):
+                expect = 7.0 if t in np.asarray(idx)[b] else None
+                if expect is not None:
+                    assert (np.asarray(back)[b, t] == 7.0).all()
+                else:
+                    np.testing.assert_array_equal(np.asarray(back)[b, t], np.asarray(x)[b, t])
+
+    def test_layer_wrapper_bypasses_in_eval(self):
+        sched = RandomLTDScheduler(4, 16, 10)
+        calls = []
+
+        def layer(params, x):  # noqa: ARG001
+            calls.append(x.shape[1])
+            return x * 2
+
+        wrapped = RandomLayerTokenDrop(layer, sched)
+        x = jnp.ones((2, 16, 4))
+        wrapped(None, x, jax.random.PRNGKey(0), train=True)
+        assert calls[-1] == 4  # subset
+        wrapped(None, x, jax.random.PRNGKey(0), train=False)
+        assert calls[-1] == 16  # full
+
+
+class TestSamplers:
+    def test_distributed_sampler_partition(self):
+        idx0 = list(DistributedSampler(100, num_replicas=4, rank=0, shuffle=False))
+        idx1 = list(DistributedSampler(100, num_replicas=4, rank=1, shuffle=False))
+        assert len(idx0) == len(idx1) == 25
+        assert not set(idx0) & set(idx1)
+
+    def test_curriculum_sampler_respects_difficulty(self):
+        cfg = {
+            "min_difficulty": 1,
+            "max_difficulty": 10,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 100, "difficulty_step": 1},
+        }
+        sched = CurriculumScheduler(cfg)
+        difficulties = np.arange(100) % 10 + 1
+        sampler = DeepSpeedDataSampler(difficulties, sched, global_batch_size=8)
+        it = iter(sampler)
+        first = [next(it) for _ in range(8)]
+        assert all(difficulties[i] <= 2 for i in first)  # early = easy
