@@ -85,6 +85,11 @@ class ThreadPool {
                 tasks_.pop_front();
             }
             int rc = task.fn();
+            // Release the task's closure BEFORE decrementing pending_: the last
+            // chunk's lambda holds the final FdGuard reference, and its
+            // fsync/close must complete (and record any error) before wait()
+            // can observe pending_ == 0.
+            task.fn = nullptr;
             {
                 std::unique_lock<std::mutex> lk(mu_);
                 if (rc != 0) ++errors_;

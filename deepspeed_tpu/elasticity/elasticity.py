@@ -111,28 +111,55 @@ def get_compatible_gpus_v02(
     num_gpus_per_node: int = 1,
     model_parallel_size: int = 1,
 ):
-    """v0.2: model-parallel-aware (reference :126) — chip counts must be
-    multiples of mp_size × chips_per_node (whole model replicas on whole
-    nodes); returns (batch, valid counts, micro-batch for current size)."""
-    if model_parallel_size > 1 and model_parallel_size % num_gpus_per_node != 0:
-        raise ElasticityError(
-            f"model_parallel_size {model_parallel_size} must be a multiple of "
-            f"chips per node {num_gpus_per_node}"
-        )
-    dp_size_per_node = max(1, num_gpus_per_node // model_parallel_size) if model_parallel_size <= num_gpus_per_node else 1
+    """v0.2: model-parallel-aware (reference `_get_compatible_gpus_v02` :126).
 
-    final_batch_size, valid_world = get_compatible_gpus_v01(
+    Works at NODE granularity: each node holds ``num_gpus_per_node //
+    model_parallel_size`` data-parallel replicas, so chips per node must be
+    divisible by mp_size, the v0.1 search runs over node counts, and results
+    scale by dp_size_per_node. Returns (batch, valid dp world sizes,
+    micro-batch for the current size); when the current size is not in the
+    valid list, falls back to a batch built around the current dp size.
+    """
+    import math
+
+    if num_gpus_per_node % model_parallel_size != 0:
+        raise ElasticityError(
+            f"chips per node {num_gpus_per_node} must be divisible by "
+            f"model_parallel_size {model_parallel_size}"
+        )
+    dp_size_per_node = num_gpus_per_node // model_parallel_size
+
+    def _micro_for(batch: int):
+        chosen = None
+        for mb in micro_batches:
+            if (batch // current_num_gpus) % mb == 0 and (
+                chosen is None or (prefer_larger and mb > chosen)
+            ):
+                chosen = mb
+        return chosen
+
+    final_batch_size, valid_node_counts = get_compatible_gpus_v01(
         micro_batches,
-        max_acceptable_batch_size=max_acceptable_batch_size // model_parallel_size,
-        min_gpus=min_gpus,
-        max_gpus=max_gpus // model_parallel_size,
+        max_acceptable_batch_size=int(max_acceptable_batch_size / dp_size_per_node),
+        min_gpus=int(min_gpus / num_gpus_per_node),
+        max_gpus=int(max_gpus / num_gpus_per_node),
         prefer_larger=prefer_larger,
     )
-    final_batch_size = int(final_batch_size) * model_parallel_size
-    valid_dp_world_sizes = [i * model_parallel_size for i in valid_world]
-    if current_num_gpus // model_parallel_size in valid_world:
-        return final_batch_size, valid_dp_world_sizes, current_num_gpus // model_parallel_size
-    return final_batch_size, valid_dp_world_sizes, None
+    final_batch_size = int(final_batch_size) * dp_size_per_node
+    valid_dp_world_sizes = [i * dp_size_per_node for i in valid_node_counts]
+    if current_num_gpus // model_parallel_size in valid_dp_world_sizes:
+        return final_batch_size, valid_dp_world_sizes, _micro_for(final_batch_size)
+
+    # current world size incompatible with the node-level search — build the
+    # closest batch
+    # around the dp size we actually have (reference :172)
+    current_dp_size = (current_num_gpus / num_gpus_per_node) * dp_size_per_node
+    candidates = [
+        math.floor(max_acceptable_batch_size / (mb * current_dp_size)) * mb * current_dp_size
+        for mb in micro_batches
+    ]
+    candidate_batch = max(candidates) if prefer_larger else min(candidates)
+    return int(candidate_batch), [int(current_dp_size)], _micro_for(int(candidate_batch))
 
 
 def get_best_candidates(candidate_batch_sizes, micro_batches, min_gpus, max_gpus, prefer_larger):
@@ -197,8 +224,16 @@ def ensure_immutable_elastic_config(runtime_elastic_config_dict: dict) -> None:
 
 def compute_elastic_config(ds_config: dict, target_deepspeed_version: str, world_size: int = 0, return_microbatch: bool = False):
     """Core entry (reference `compute_elastic_config` :233): returns
-    (final_batch_size, valid_gpus[, micro_batch]) and validates world_size
-    when given."""
+    (final_batch_size, valid_gpus) — plus micro_batch when
+    ``return_microbatch`` — and validates world_size when given.
+
+    Reference-contract note: under v0.2 ``valid_gpus`` holds *data-parallel
+    world sizes* (chips / mp), and the world_size validation and micro-batch
+    divisibility both compare against that unit, exactly as the reference
+    does (:350, :355). Callers using model parallelism pass world_size in
+    dp units, matching the reference's logged "Valid World Size
+    (GPUs / Model Parallel Size)" semantics.
+    """
     if ELASTICITY not in ds_config:
         raise ElasticityConfigError(f"'{ELASTICITY}' is missing from config json")
     elastic_config_dict = ds_config[ELASTICITY]
@@ -233,7 +268,14 @@ def compute_elastic_config(ds_config: dict, target_deepspeed_version: str, world
         else:
             import os
 
-            current_num_gpus = int(os.environ.get("WORLD_SIZE", 1))
+            ws_env = os.environ.get("WORLD_SIZE")
+            if ws_env is None or not ws_env.isnumeric() or int(ws_env) <= 0:
+                raise ElasticityConfigError(
+                    "Elasticity v0.2 needs a positive WORLD_SIZE to compute a "
+                    "valid batch size; pass world_size= or set the WORLD_SIZE "
+                    f"env var (currently {ws_env!r})"
+                )
+            current_num_gpus = int(ws_env)
         final_batch_size, valid_gpus, candidate_microbatch_size = get_compatible_gpus_v02(
             micro_batches=elastic_config.micro_batches,
             max_acceptable_batch_size=elastic_config.max_acceptable_batch_size,
@@ -255,15 +297,18 @@ def compute_elastic_config(ds_config: dict, target_deepspeed_version: str, world
                 f"valid chip counts: {valid_gpus}"
             )
         # chosen micro batch: largest micro that divides batch/world evenly
-        if micro_batch is None:
-            candidates = [
-                mb
-                for mb in elastic_config.micro_batches
-                if final_batch_size % (mb * world_size) == 0
-            ]
-            micro_batch = max(candidates) if candidates else None
-        if return_microbatch or micro_batch is not None:
-            return final_batch_size, valid_gpus, micro_batch
+        # (reference :355)
+        candidates = [
+            mb
+            for mb in sorted(set(elastic_config.micro_batches), reverse=True)
+            if (final_batch_size // world_size) % mb == 0
+        ]
+        if not candidates:
+            raise ElasticityError(
+                f"Unable to find divisible micro batch size: world_size={world_size}, "
+                f"final_batch_size={final_batch_size}, micro_batches={elastic_config.micro_batches}"
+            )
+        micro_batch = candidates[0]
     if return_microbatch:
         return final_batch_size, valid_gpus, micro_batch
     return final_batch_size, valid_gpus

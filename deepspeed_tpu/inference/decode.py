@@ -203,11 +203,16 @@ def generate(
         else:
             next_tok = jnp.argmax(logits, axis=-1)
         next_tok = next_tok.astype(tokens.dtype)
-        out.append(next_tok[:, None])
         if eos_token_id is not None:
+            # rows that already emitted EOS keep emitting EOS (padding), not
+            # arbitrary continuation tokens
+            next_tok = jnp.where(jnp.asarray(finished), jnp.asarray(eos_token_id, tokens.dtype), next_tok)
+            out.append(next_tok[:, None])
             finished |= np.asarray(jax.device_get(next_tok)) == eos_token_id
             if finished.all():
                 break
+        else:
+            out.append(next_tok[:, None])
         logits, cache = decode_step(params, next_tok, cache, jnp.int32(pos))
         pos += 1
     return jnp.concatenate(out, axis=1)

@@ -40,7 +40,7 @@ class TestV01:
     def test_compatible_world_size(self):
         final_batch, valid_gpus = compute_elastic_config(BASE_CONFIG, "0.1.0")
         ws = valid_gpus[0]
-        fb, vg, mb = compute_elastic_config(BASE_CONFIG, "0.1.0", world_size=ws)
+        fb, vg, mb = compute_elastic_config(BASE_CONFIG, "0.1.0", world_size=ws, return_microbatch=True)
         assert fb == final_batch
         assert mb in BASE_CONFIG["elasticity"]["micro_batch_sizes"]
         assert fb % (mb * ws) == 0
@@ -85,23 +85,68 @@ class TestV01Math:
 
 
 class TestV02:
-    def test_model_parallel(self):
-        cfg = {
-            "elasticity": {
-                "enabled": True,
-                "max_train_batch_size": 2048,
-                "micro_batch_sizes": [2, 4],
-                "min_gpus": 1,
-                "max_gpus": 1024,
-                "version": 0.2,
-                "model_parallel_size": 4,
-                "num_gpus_per_node": 4,
-            }
+    @staticmethod
+    def _cfg(**over):
+        base = {
+            "enabled": True,
+            "max_train_batch_size": 2048,
+            "micro_batch_sizes": [2, 4],
+            "min_gpus": 1,
+            "max_gpus": 1024,
+            "version": 0.2,
+            "model_parallel_size": 4,
+            "num_gpus_per_node": 4,
         }
-        fb, valid_gpus, mb = compute_elastic_config(cfg, "0.1.0", world_size=0, return_microbatch=True)
-        assert fb % 4 == 0  # multiple of mp size
-        for g in valid_gpus:
-            assert g % 4 == 0
+        base.update(over)
+        return {"elasticity": base}
+
+    def test_model_parallel(self):
+        # mp == chips/node → one dp replica per node; valid counts are node counts
+        fb, valid_gpus, mb = compute_elastic_config(
+            self._cfg(), "0.1.0", world_size=8, return_microbatch=True
+        )
+        assert fb > 0 and fb <= 2048
+        assert 8 in valid_gpus
+        assert mb in (2, 4)
+        assert (fb // 8) % mb == 0
+
+    def test_mp_smaller_than_node(self):
+        # mp=2 on 8-chip nodes: 4 dp replicas per node (the reference node-level
+        # contract ADVICE flagged) — must NOT raise, and valid dp sizes scale by 4
+        fb, valid_gpus, mb = compute_elastic_config(
+            self._cfg(model_parallel_size=2, num_gpus_per_node=8, max_gpus=256),
+            "0.1.0",
+            world_size=8,
+            return_microbatch=True,
+        )
+        assert fb > 0
+        assert all(g % 4 == 0 for g in valid_gpus)  # whole nodes → multiples of dp/node
+        assert mb in (2, 4)
+
+    def test_mp_not_dividing_node_raises(self):
+        from deepspeed_tpu.elasticity.elasticity import ElasticityError
+
+        with pytest.raises(ElasticityError):
+            compute_elastic_config(
+                self._cfg(model_parallel_size=3, num_gpus_per_node=8),
+                "0.1.0",
+                world_size=8,
+            )
+
+    def test_two_tuple_without_return_microbatch(self):
+        out = compute_elastic_config(self._cfg(), "0.1.0", world_size=8)
+        assert len(out) == 2
+
+    def test_world_size_required(self):
+        import os
+
+        old = os.environ.pop("WORLD_SIZE", None)
+        try:
+            with pytest.raises(ElasticityConfigError):
+                compute_elastic_config(self._cfg(), "0.1.0", world_size=0)
+        finally:
+            if old is not None:
+                os.environ["WORLD_SIZE"] = old
 
     def test_v01_rejects_model_parallel(self):
         cfg = {

@@ -82,3 +82,24 @@ def test_cache_shapes():
     cache = init_cache(cfg, batch=2, max_len=16)
     assert cache.k.shape == (3, 2, 16, cfg.num_kv_heads, cfg.head_dim)
     assert cache.max_len == 16
+
+
+def test_generate_pads_finished_rows_with_eos():
+    """Rows that emit EOS must keep emitting EOS, not arbitrary tokens."""
+    cfg = llama_config("tiny", num_layers=2, max_seq_len=64)
+    cfg.flash_attention = False
+    model = TransformerLM(cfg)
+    rng = jax.random.PRNGKey(0)
+    B, T = 2, 4
+    toks = jax.random.randint(jax.random.PRNGKey(3), (B, T), 0, cfg.vocab_size)
+    params = model.init(rng, toks)
+
+    # pick the first token row 0 would greedily emit as the "eos" id so that
+    # row 0 finishes immediately while row 1 (different prompt) continues
+    probe = generate(cfg, params, toks, max_new_tokens=1)
+    eos = int(np.asarray(probe)[0, T])
+    out = np.asarray(generate(cfg, params, toks, max_new_tokens=6, eos_token_id=eos))
+    row0_new = out[0, T:]
+    first_eos = int(np.argmax(row0_new == eos))
+    assert row0_new[first_eos] == eos
+    assert (row0_new[first_eos:] == eos).all(), f"post-EOS tokens not padded: {row0_new}"
