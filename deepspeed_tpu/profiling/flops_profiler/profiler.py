@@ -117,7 +117,8 @@ class FlopsProfiler:
             e = self.ds_engine
             try:
                 if getattr(e, "_last_profile_args", None) is not None:
-                    self.cost = get_compiled_cost(e._jit_fwd_bwd, *e._last_profile_args)
+                    fn = getattr(e, "_profile_fn", None) or e._jit_fwd_bwd
+                    self.cost = get_compiled_cost(fn, *e._last_profile_args)
             except Exception as ex:  # cost analysis is best-effort
                 logger.debug(f"flops cost analysis unavailable: {ex}")
 

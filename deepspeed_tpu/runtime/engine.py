@@ -204,6 +204,13 @@ class DeepSpeedEngine:
 
         self._host_offload = None
         self.partitioner: Optional[ZeroPartitioner] = None
+        self._fused_step_enabled = False
+        self._pending_commit = None
+        self._jit_fused_step = None
+        self._profile_fn = None
+        self._last_batch = None
+        self._last_fwd_rng = None
+        self._jit_debug_grad = None
         self._jit_fwd_bwd = None
         self._jit_eval = None
         self._jit_step = None
@@ -420,13 +427,16 @@ class DeepSpeedEngine:
             self._opt_state = jax.jit(self.optimizer.init_state, out_shardings=opt_shardings)(self._master)
             self._opt_shardings = opt_shardings
 
-        zeros32 = jax.jit(
-            lambda t: jax.tree_util.tree_map(lambda x: jnp.zeros(x.shape, jnp.float32), t),
-            out_shardings=grad_shardings,
-        )
-        self._grad_acc = zeros32(self._params)
         self._scale_state = jax.device_put(self.loss_scaler.init_state())
         self._build_jitted_fns()
+        if not self._fused_step_enabled:
+            # fp32 accumulation buffer only exists when micro-steps accumulate
+            # across calls; the fused path keeps grads inside one program
+            zeros32 = jax.jit(
+                lambda t: jax.tree_util.tree_map(lambda x: jnp.zeros(x.shape, jnp.float32), t),
+                out_shardings=grad_shardings,
+            )
+            self._grad_acc = zeros32(self._params)
         self._initialized = True
         n_params = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(self._params))
         log_dist(f"Initialized model state: {n_params:,} parameters", ranks=[0])
@@ -514,22 +524,24 @@ class DeepSpeedEngine:
 
         self._jit_eval = jax.jit(eval_fwd)
 
-        def step_fn(params_or_none, master, opt_state, grad_acc, scale_state, lr):
-            params = master if params_or_none is None else params_or_none
-            inv = 1.0 / (scale_state.scale * gas)
-            grads = jax.tree_util.tree_map(lambda g: g * inv, grad_acc)
-            overflow = has_inf_or_nan(grads) if fp16 else jnp.zeros((), jnp.bool_)
+        def update_from_grads(grads32, params, master, opt_state, scale_state, lr):
+            """Shared optimizer-update body: unscaled fp32 grads → new state.
+
+            Overflow check, global-norm clip, optimizer apply, overflow-revert
+            (a ``where``, not a host sync), compute-dtype re-cast, loss-scale
+            update. Used by both the standalone step and the fused micro-step
+            so the update math lives in exactly one place."""
+            overflow = has_inf_or_nan(grads32) if fp16 else jnp.zeros((), jnp.bool_)
             # global grad norm: full reductions over sharded leaves are global
-            sq = sum(jnp.sum(jnp.square(g)) for g in jax.tree_util.tree_leaves(grads))
+            sq = sum(jnp.sum(jnp.square(g)) for g in jax.tree_util.tree_leaves(grads32))
             grad_norm = jnp.sqrt(sq)
             if clip > 0:
                 coef = jnp.minimum(1.0, clip / (grad_norm + 1e-6))
-                grads = jax.tree_util.tree_map(lambda g: g * coef, grads)
-            new_master, new_opt = optimizer.apply(grads, opt_state, master, jnp.float32(lr))
-            keep = lambda new, old: jax.tree_util.tree_map(
-                lambda n, o: jnp.where(overflow, o, n), new, old
+                grads32 = jax.tree_util.tree_map(lambda g: g * coef, grads32)
+            new_master, new_opt = optimizer.apply(grads32, opt_state, master, jnp.float32(lr))
+            new_master = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(overflow, o, n), new_master, master
             )
-            new_master = keep(new_master, master)
             new_opt = jax.tree_util.tree_map(
                 lambda n, o: jnp.where(overflow, o, n), new_opt, opt_state
             )
@@ -541,9 +553,84 @@ class DeepSpeedEngine:
                 )
             else:
                 new_params = new_master
-            zeroed = jax.tree_util.tree_map(jnp.zeros_like, grad_acc)
             new_scale_state = scaler.update(scale_state, overflow)
+            return new_params, new_master, new_opt, new_scale_state, grad_norm, overflow
+
+        def step_fn(params_or_none, master, opt_state, grad_acc, scale_state, lr):
+            params = master if params_or_none is None else params_or_none
+            inv = 1.0 / (scale_state.scale * gas)
+            grads = jax.tree_util.tree_map(lambda g: g * inv, grad_acc)
+            new_params, new_master, new_opt, new_scale_state, grad_norm, overflow = (
+                update_from_grads(grads, params, master, opt_state, scale_state, lr)
+            )
+            zeroed = jax.tree_util.tree_map(jnp.zeros_like, grad_acc)
             return new_params, new_master, new_opt, zeroed, new_scale_state, grad_norm, overflow
+
+        # fully-fused micro-step: when every training forward IS a full step
+        # (_gas_divisor == 1: dense gas=1, or the SPMD pipeline which folds
+        # all microbatches into one fwd_bwd), run forward+backward+optimizer
+        # as ONE jitted program. Grads never round-trip through the fp32
+        # accumulation buffer, XLA overlaps the optimizer update with the
+        # tail of the backward, and the host dispatches once per step —
+        # this is the single biggest single-chip throughput lever on the
+        # tunneled TPU backend (dispatch RTT is paid per program).
+        self._fused_step_enabled = (
+            self._gas_divisor == 1 and self._host_offload is None
+        )
+
+        def fused_step(params_or_none, master, opt_state, scale_state, lr, rng, batch):
+            params = master if params_or_none is None else params_or_none
+            rng, sub = jax.random.split(rng)
+            scale = scale_state.scale
+
+            def scaled_loss(p):
+                return loss_of(p, batch, sub) * scale.astype(jnp.float32)
+
+            loss_scaled, grads = jax.value_and_grad(scaled_loss)(params)
+            loss = loss_scaled / scale.astype(jnp.float32)
+            inv = 1.0 / scale
+            grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32) * inv, grads)
+            new_params, new_master, new_opt, new_scale_state, grad_norm, overflow = (
+                update_from_grads(grads, params, master, opt_state, scale_state, lr)
+            )
+            return loss, new_params, new_master, new_opt, new_scale_state, grad_norm, overflow, rng
+
+        if self._fused_step_enabled:
+            if mixed:
+                self._jit_fused_step = jax.jit(
+                    fused_step,
+                    donate_argnums=(0, 1, 2),
+                    out_shardings=(
+                        None,
+                        self._param_shardings,
+                        self._master_shardings,
+                        self._opt_shardings,
+                        None,
+                        None,
+                        None,
+                        None,
+                    ),
+                )
+            else:
+                def fp32_fused_step(master, opt_state, scale_state, lr, rng, batch):
+                    out = fused_step(None, master, opt_state, scale_state, lr, rng, batch)
+                    return out[0], out[2], out[3], out[4], out[5], out[6], out[7]
+
+                self._jit_fused_step = jax.jit(
+                    fp32_fused_step,
+                    donate_argnums=(0, 1),
+                    out_shardings=(
+                        None,
+                        self._master_shardings,
+                        self._opt_shardings,
+                        None,
+                        None,
+                        None,
+                        None,
+                    ),
+                )
+        else:
+            self._jit_fused_step = None
 
         if self._host_offload is not None:
             # offload path: the fused device step is replaced by (tiny jitted
@@ -616,7 +703,9 @@ class DeepSpeedEngine:
             seqlen = self.curriculum_scheduler.update_difficulty(self.global_steps + 1)
             batch = _truncate_seq(batch, seqlen)
         placed = self._place_batch(batch)
-        self._rng, step_rng = jax.random.split(self._rng)
+        fused_train = self._training_mode and self._fused_step_enabled
+        if not fused_train:
+            self._rng, step_rng = jax.random.split(self._rng)
         profiling = (
             self.flops_profiler is not None
             and self.global_steps == self._config.flops_profiler_config.profile_step
@@ -627,7 +716,49 @@ class DeepSpeedEngine:
         )
         if profiling:
             self.flops_profiler.start_profile()
-        if self._training_mode:
+        if fused_train:
+            if self._pending_commit is not None:
+                raise RuntimeError(
+                    "forward() called again before step(): with "
+                    "gradient_accumulation_steps=1 the engine fuses the "
+                    "optimizer update into the forward program, so every "
+                    "training forward must be followed by backward()+step()"
+                )
+            lr = self.optimizer.param_groups[0]["lr"]
+            parent_rng = self._rng
+            if self.mixed_precision:
+                fwd_args = (
+                    self._params, self._master, self._opt_state,
+                    self._scale_state, lr, self._rng, placed,
+                )
+            else:
+                fwd_args = (
+                    self._master, self._opt_state, self._scale_state, lr, self._rng, placed,
+                )
+            if profiling:
+                self._last_profile_args = jax.tree_util.tree_map(
+                    lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
+                    if hasattr(x, "shape")
+                    else x,
+                    fwd_args,
+                )
+                self._profile_fn = self._jit_fused_step
+            out = self._jit_fused_step(*fwd_args)
+            # the inputs were donated — adopt the new state immediately so the
+            # engine never holds references to deleted buffers
+            if self.mixed_precision:
+                loss, self._params, self._master, self._opt_state, self._scale_state, norm, ovf, self._rng = out
+            else:
+                loss, self._master, self._opt_state, self._scale_state, norm, ovf, self._rng = out
+                self._params = self._master
+            self._pending_commit = (norm, ovf)
+            # host-side batch reference only (no HBM pin) for the on-demand
+            # debug-grad surface (get_last_grads)
+            self._last_batch = batch
+            self._last_fwd_rng = parent_rng
+            self._last_loss = loss
+            self._in_forward = True
+        elif self._training_mode:
             fwd_args = (self._params, self._grad_acc, self._scale_state.scale, step_rng, placed)
             if profiling:
                 # abstract shapes only: grad_acc is donated by the call below
@@ -637,25 +768,26 @@ class DeepSpeedEngine:
                     else x,
                     fwd_args,
                 )
+                self._profile_fn = self._jit_fwd_bwd
             loss, self._grad_acc = self._jit_fwd_bwd(*fwd_args)
             self._last_loss = loss
             self._in_forward = True
-            if profiling:
-                jax.device_get(loss)  # close the latency window at step end
-                pcfg = self._config.flops_profiler_config
-                self.flops_profiler.stop_profile()
-                self.flops_profiler.print_model_profile(
-                    profile_step=pcfg.profile_step,
-                    module_depth=pcfg.module_depth,
-                    top_modules=pcfg.top_modules,
-                    detailed=pcfg.detailed,
-                    output_file=pcfg.output_file,
-                )
-                self.flops_profiler.end_profile()
-                self._last_profile_args = None
         else:
             loss = self._jit_eval(self._params, step_rng, placed)
             self._last_loss = loss
+        if profiling:
+            jax.device_get(loss)  # close the latency window at step end
+            pcfg = self._config.flops_profiler_config
+            self.flops_profiler.stop_profile()
+            self.flops_profiler.print_model_profile(
+                profile_step=pcfg.profile_step,
+                module_depth=pcfg.module_depth,
+                top_modules=pcfg.top_modules,
+                detailed=pcfg.detailed,
+                output_file=pcfg.output_file,
+            )
+            self.flops_profiler.end_profile()
+            self._last_profile_args = None
         self.timers(FORWARD_GLOBAL_TIMER).stop(sync=False)
         return loss
 
@@ -751,22 +883,41 @@ class DeepSpeedEngine:
         self._scale_state = self.loss_scaler.update(self._scale_state, overflow_flag)
         self._overflow = overflow
 
+    def _finish_step_bookkeeping(self, overflow_flag) -> None:
+        """Post-update host tail shared by every step flavor: counters,
+        fp16 overflow accounting (the only host-visible sync, and only under
+        fp16), lr scheduler, monitor."""
+        self.global_steps += 1
+        if self._config.fp16_enabled and overflow_flag is not None:
+            self._overflow = (
+                overflow_flag
+                if isinstance(overflow_flag, bool)
+                else bool(jax.device_get(overflow_flag))
+            )
+        if self._overflow:
+            self.skipped_steps += 1
+            log_dist(
+                f"[deepspeed_tpu] OVERFLOW! skipping step, new loss scale: {self.loss_scale}",
+                ranks=[0],
+            )
+        if self.lr_scheduler is not None and not self._overflow:
+            self.lr_scheduler.step()
+        self._overflow = False
+        if self.monitor is not None and self.global_steps % self._config.steps_per_print == 0:
+            self._write_monitor()
+
     def _take_model_step(self) -> None:
+        if self._fused_step_enabled:
+            if self._pending_commit is None:
+                raise RuntimeError("step() called with no pending forward()")
+            self._last_grad_norm, overflow_flag = self._pending_commit
+            self._pending_commit = None
+            self._finish_step_bookkeeping(overflow_flag)
+            return
         lr = self.optimizer.param_groups[0]["lr"]
         if self._host_offload is not None:
-            self._take_offload_step(lr)
-            self.global_steps += 1
-            if self._overflow:
-                self.skipped_steps += 1
-                log_dist(
-                    f"[deepspeed_tpu] OVERFLOW! skipping step, new loss scale: {self.loss_scale}",
-                    ranks=[0],
-                )
-            if self.lr_scheduler is not None and not self._overflow:
-                self.lr_scheduler.step()
-            self._overflow = False
-            if self.monitor is not None and self.global_steps % self._config.steps_per_print == 0:
-                self._write_monitor()
+            self._take_offload_step(lr)  # sets self._overflow itself
+            self._finish_step_bookkeeping(self._overflow)
             return
         if self.mixed_precision:
             (
@@ -790,21 +941,7 @@ class DeepSpeedEngine:
                 overflow_flag,
             ) = self._jit_step(self._master, self._opt_state, self._grad_acc, self._scale_state, lr)
             self._params = self._master
-        self.global_steps += 1
-        if self._config.fp16_enabled:
-            # only fp16 needs the host-visible flag (scheduler skip + counters)
-            self._overflow = bool(jax.device_get(overflow_flag))
-            if self._overflow:
-                self.skipped_steps += 1
-                log_dist(
-                    f"[deepspeed_tpu] OVERFLOW! skipping step, new loss scale: {self.loss_scale}",
-                    ranks=[0],
-                )
-        if self.lr_scheduler is not None and not self._overflow:
-            self.lr_scheduler.step()
-        self._overflow = False
-        if self.monitor is not None and self.global_steps % self._config.steps_per_print == 0:
-            self._write_monitor()
+        self._finish_step_bookkeeping(overflow_flag)
 
     def _write_monitor(self) -> None:
         events = [
@@ -991,6 +1128,36 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     def get_params(self):
         return self._params
+
+    def get_last_grads(self):
+        """Gradient tree of the latest training micro-batch (debug/inspection
+        surface behind ``safe_get_full_grad``). On the accumulating path this
+        is the live fp32 accumulator; on the fused path grads only exist
+        inside the step program, so they are recomputed here on the stashed
+        batch at the CURRENT (post-update) params and loss scale — close to
+        but not identical to what the step consumed (in particular, after an
+        fp16 overflow this reflects the reverted params and the new scale)."""
+        if not self._fused_step_enabled:
+            return self._grad_acc
+        if self._last_batch is None:
+            return None
+        if self._jit_debug_grad is None:
+            module = self.module
+
+            def dbg(params, rng, scale, batch):
+                def scaled_loss(p):
+                    out = module.apply(p, batch, rngs={"dropout": rng}, train=True)
+                    loss = out[0] if isinstance(out, tuple) else out
+                    return loss * scale.astype(jnp.float32)
+
+                g = jax.grad(scaled_loss)(params)
+                return jax.tree_util.tree_map(lambda x: x.astype(jnp.float32), g)
+
+            self._jit_debug_grad = jax.jit(dbg)
+        _, sub = jax.random.split(self._last_fwd_rng)
+        return self._jit_debug_grad(
+            self._params, sub, self._scale_state.scale, self._place_batch(self._last_batch)
+        )
 
     def get_master_params(self):
         if self._host_offload is not None:

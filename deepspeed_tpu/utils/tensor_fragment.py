@@ -113,10 +113,12 @@ def _refresh_param_from_master(engine, name: str, value: np.ndarray) -> None:
 def safe_get_full_grad(engine, name: str) -> Optional[np.ndarray]:
     """Full (accumulated) gradient of ``name`` (reference
     ``safe_get_full_grad``). Note grads are scaled by loss-scale × gas until
-    the step consumes them."""
-    if engine._grad_acc is None:
+    the step consumes them. Under the engine's fused step there is no live
+    accumulator; the grad is recomputed from the last micro-batch."""
+    grads = engine.get_last_grads() if hasattr(engine, "get_last_grads") else engine._grad_acc
+    if grads is None:
         return None
-    flat = _flatten_with_paths(engine._grad_acc)
+    flat = _flatten_with_paths(grads)
     if name not in flat:
         return None
     return np.asarray(jax.device_get(flat[name]), dtype=np.float32)

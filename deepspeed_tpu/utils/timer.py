@@ -103,20 +103,35 @@ class NoopTimer:
 
 
 class ThroughputTimer:
+    """Samples/sec reporting (reference ``ThroughputTimer``).
+
+    The reference synchronizes the accelerator around EVERY step to time it
+    (cheap on a local CUDA stream). Here a sync drains the async dispatch
+    queue — on TPU (worse: on a tunneled backend) that serializes host and
+    device and can dominate the step time. So this timer measures whole
+    *logging windows* instead: it syncs once per ``steps_per_output`` steps,
+    divides wall-clock by the window's sample count, and leaves the hot loop
+    fully async. Steady-state numbers are identical; only sub-window
+    per-step resolution is given up.
+    """
+
     def __init__(self, batch_size: int, start_step: int = 2, steps_per_output: int = 50, monitor_memory: bool = False, logging_fn=None):
         self.batch_size = max(batch_size, 1)
         self.start_step = start_step
-        self.steps_per_output = steps_per_output
+        self.steps_per_output = max(steps_per_output, 1)
         self.monitor_memory = monitor_memory
         self.logging = logging_fn
         self.epoch_count = 0
         self.micro_step_count = 0
         self.global_step_count = 0
         self.total_elapsed_time = 0.0
-        self.step_elapsed_time = 0.0
         self.started = False
-        self.start_time = 0.0
         self.initialized = False
+        self._window_open = False
+        self._window_start_time = 0.0
+        self._window_start_step = 0
+        self._measured_steps = 0
+        self._last_window_rate = 0.0
 
     def update_epoch_count(self):
         self.epoch_count += 1
@@ -124,9 +139,14 @@ class ThroughputTimer:
 
     def start(self):
         self.started = True
-        if self.global_step_count >= self.start_step:
+        if not self._window_open and self.global_step_count >= self.start_step:
+            # open a measurement window on a drained queue: host work between
+            # windows (checkpoint saves, eval loops) is not counted
             _sync()
-            self.start_time = time.perf_counter()
+            self._window_start_time = time.perf_counter()
+            self._window_start_step = self.global_step_count
+            self._window_open = True
+            self.initialized = True
 
     def stop(self, global_step: bool = False, report_speed: bool = True):
         if not self.started:
@@ -135,22 +155,27 @@ class ThroughputTimer:
         self.micro_step_count += 1
         if global_step:
             self.global_step_count += 1
-        if self.start_time and self.global_step_count > self.start_step:
-            _sync()
-            duration = time.perf_counter() - self.start_time
-            self.total_elapsed_time += duration
-            self.step_elapsed_time += duration
-            if global_step and report_speed and self.logging and self.global_step_count % self.steps_per_output == 0:
-                self.logging(
-                    f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
-                    f"global_step={self.global_step_count}, RunningAvgSamplesPerSec={self.avg_samples_per_sec():.2f}, "
-                    f"CurrSamplesPerSec={self.batch_size / self.step_elapsed_time:.2f}"
-                )
-            if global_step:
-                self.step_elapsed_time = 0.0
+        if not (self._window_open and global_step):
+            return
+        window_steps = self.global_step_count - self._window_start_step
+        if window_steps < self.steps_per_output and self.global_step_count % self.steps_per_output != 0:
+            return
+        _sync()
+        now = time.perf_counter()
+        duration = now - self._window_start_time
+        self.total_elapsed_time += duration
+        self._measured_steps += window_steps
+        if duration > 0:
+            self._last_window_rate = self.batch_size * window_steps / duration
+        if report_speed and self.logging:
+            self.logging(
+                f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
+                f"global_step={self.global_step_count}, RunningAvgSamplesPerSec={self.avg_samples_per_sec():.2f}, "
+                f"CurrSamplesPerSec={self._last_window_rate:.2f}"
+            )
+        self._window_open = False
 
     def avg_samples_per_sec(self) -> float:
-        if self.total_elapsed_time > 0 and self.global_step_count > self.start_step:
-            samples = self.batch_size * (self.global_step_count - self.start_step)
-            return samples / self.total_elapsed_time
+        if self.total_elapsed_time > 0 and self._measured_steps > 0:
+            return self.batch_size * self._measured_steps / self.total_elapsed_time
         return 0.0

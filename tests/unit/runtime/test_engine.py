@@ -90,12 +90,18 @@ def test_eval_mode_no_grad_side_effects(eight_devices):
     loss = engine(batch)
     engine.backward(loss)
     engine.step()
-    acc_before = jax.device_get(engine._grad_acc["w0"])
+    master_before = jax.device_get(engine.get_master_params()["w0"])
+    steps_before = engine.global_steps
     engine.eval()
     _ = engine(batch)
     with pytest.raises(RuntimeError):
         engine.backward(loss)
-    np.testing.assert_array_equal(jax.device_get(engine._grad_acc["w0"]), acc_before)
+    np.testing.assert_array_equal(
+        jax.device_get(engine.get_master_params()["w0"]), master_before
+    )
+    assert engine.global_steps == steps_before
+    if engine._grad_acc is not None:
+        np.testing.assert_array_equal(jax.device_get(engine._grad_acc["w0"]), 0.0)
     engine.train()
 
 
