@@ -79,6 +79,13 @@ class MoETransformerLM(TransformerLM):
     def _is_moe_layer(self, i: int) -> bool:
         return (i + 1) % self.config.moe_layer_freq == 0
 
+    def stream_fns(self):
+        raise NotImplementedError(
+            "offload_param layer streaming does not support MoE families: the "
+            "expert params live outside the stacked layer tree and the "
+            "load-balance aux loss cannot ride the per-layer stream programs"
+        )
+
     # --- params ---------------------------------------------------------
     def init(self, rng, batch) -> Dict[str, Any]:
         cfg = self.config

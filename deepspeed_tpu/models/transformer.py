@@ -389,6 +389,54 @@ class TransformerLM(DSModule):
             logits = x @ params["lm_head"].astype(self.dtype)
         return logits, aux_total
 
+    # --- layer streaming (ZeRO-Infinity param offload) -------------------
+    def stream_fns(self):
+        """Split the forward into (embed, layer, head) programs for the
+        layer-streamed param-offload engine (``runtime/zero/param_offload.py``;
+        reference analog: ``deepspeed/runtime/swap_tensor/partitioned_param_swapper.py:36``
+        + the fetch/release hooks of ``zero/parameter_offload.py:342``).
+
+        Contract: ``embed_fwd(resident, tokens) -> h``,
+        ``layer_fwd(layer_params, h, positions, rng, train=True) -> h``,
+        ``head_loss(resident, h, labels) -> scalar`` (``labels=None`` →
+        logits, the inference head) — where ``resident`` is the param tree
+        minus the stacked ``"layers"`` entry and ``layer_params`` is one
+        unstacked per-layer tree. MoE aux losses are not routed through this
+        path (``MoETransformerLM.stream_fns`` raises)."""
+        cfg = self.config
+
+        def embed_fwd(resident, tokens):
+            tokens = jnp.asarray(tokens)
+            x = resident["embed"]["tokens"].astype(self.dtype)[tokens]
+            if cfg.position == "learned":
+                T = tokens.shape[1]
+                x = x + resident["embed"]["pos"].astype(self.dtype)[
+                    jnp.arange(T, dtype=jnp.int32)
+                ][None]
+            return x
+
+        def layer_fwd(layer_params, h, positions, rng, train=True):
+            out, _aux = self._layer(h, layer_params, positions, rng, train=train)
+            return out
+
+        def head_loss(resident, h, labels):
+            x = _norm(
+                h,
+                resident["final_norm_scale"],
+                resident.get("final_norm_bias"),
+                cfg.norm,
+                cfg.norm_eps,
+            )
+            if cfg.tie_embeddings:
+                logits = x @ resident["embed"]["tokens"].astype(self.dtype).T
+            else:
+                logits = x @ resident["lm_head"].astype(self.dtype)
+            if labels is None:
+                return logits
+            return cross_entropy_loss(logits, labels)
+
+        return embed_fwd, layer_fwd, head_loss
+
     def apply(self, params, batch, *, rngs=None, train: bool = True):
         tokens, labels = _split_batch(batch)
         logits, aux = self._forward(params, tokens, rngs, train)

@@ -203,6 +203,8 @@ class DeepSpeedEngine:
         self._pending_model_parameters = model_parameters
 
         self._host_offload = None
+        self._param_stream = None  # ZeRO-Infinity layer-streamed param offload
+        self._stream_scale = 1.0
         self.partitioner: Optional[ZeroPartitioner] = None
         self._fused_step_enabled = False
         self._pending_commit = None
@@ -336,6 +338,9 @@ class DeepSpeedEngine:
             return
         if rng is not None:
             self._rng = rng
+        if self._param_offload_enabled():
+            self._init_param_stream(batch)
+            return
         placed = self._place_batch(batch)
         param_shapes = jax.eval_shape(lambda r, b: self.module.init(r, b), self._rng, placed)
         tp_rules = self.module.tp_partition_rules(param_shapes)
@@ -386,18 +391,7 @@ class DeepSpeedEngine:
             from deepspeed_tpu.runtime.zero.offload_states import HostOffloadAdam
 
             opt_cfg = self._config.optimizer_config
-            opt_type = (opt_cfg.type.lower() if opt_cfg is not None and opt_cfg.type else "adam")
-            if opt_type not in (C.ADAM_OPTIMIZER, C.ADAMW_OPTIMIZER, C.FUSED_ADAM_OPTIMIZER, C.CPU_ADAM_OPTIMIZER):
-                raise ValueError(
-                    f"offload_optimizer runs the host Adam/AdamW (DeepSpeedCPUAdam "
-                    f"analog); configured optimizer {opt_type!r} is not supported with "
-                    "offload — use an adam variant or disable offload"
-                )
-            if self.client_optimizer is not None:
-                raise ValueError(
-                    "offload_optimizer is incompatible with a client optimizer: the "
-                    "host offload path owns the update rule (Adam/AdamW)"
-                )
+            self._validate_host_adam("offload_optimizer")
             params_cfg = dict(opt_cfg.params) if opt_cfg is not None else {}
             self._host_offload = HostOffloadAdam(
                 master,
@@ -703,6 +697,10 @@ class DeepSpeedEngine:
             seqlen = self.curriculum_scheduler.update_difficulty(self.global_steps + 1)
             batch = _truncate_seq(batch, seqlen)
         placed = self._place_batch(batch)
+        if self._param_stream is not None:
+            loss = self._stream_forward(placed)
+            self.timers(FORWARD_GLOBAL_TIMER).stop(sync=False)
+            return loss
         fused_train = self._training_mode and self._fused_step_enabled
         if not fused_train:
             self._rng, step_rng = jax.random.split(self._rng)
@@ -791,15 +789,49 @@ class DeepSpeedEngine:
         self.timers(FORWARD_GLOBAL_TIMER).stop(sync=False)
         return loss
 
+    def _stream_forward(self, placed):
+        """Forward on the layer-streamed param-offload path. Returns the
+        (unscaled) loss; the streamer stashes activations for backward()."""
+        from deepspeed_tpu.models.transformer import _split_batch
+
+        tokens, labels = _split_batch(placed)
+        if not self._training_mode:
+            # labels=None → logits (inference head); else eval loss
+            out = self._param_stream.eval_forward(tokens, labels)
+            if labels is not None:
+                self._last_loss = out
+            return out
+        if labels is None:
+            raise ValueError(
+                "param-offload training expects (tokens, labels) batches "
+                "(dict with input_ids/labels, or a 2-tuple)"
+            )
+        if self._in_forward:
+            raise RuntimeError(
+                "forward() called again before backward() on the param-offload "
+                "path: each microbatch's gradients are produced by backward(), "
+                "so every training forward must complete backward() first"
+            )
+        scale = float(jax.device_get(self._scale_state.scale))
+        self._rng, sub = jax.random.split(self._rng)
+        loss = self._param_stream.forward(tokens, labels, sub, scale) / scale
+        self._stream_scale = scale
+        self._in_forward = True
+        self._last_loss = loss
+        return loss
+
     def backward(self, loss, retain_graph: bool = False, scale_wrt_gas: bool = True):  # noqa: ARG002
         """Gradients were produced (fused) in ``forward``; this validates the
         call protocol and is where the reference reduces at GAS boundaries —
-        here the reduction is part of the jitted step's grad shardings."""
+        here the reduction is part of the jitted step's grad shardings.
+        On the param-offload path this runs the real layer-streamed backward."""
         if not self._training_mode:
             raise RuntimeError("backward() called in eval mode")
         if not self._in_forward:
             raise RuntimeError("backward() called before forward()")
         self.timers(BACKWARD_GLOBAL_TIMER).start()
+        if self._param_stream is not None:
+            self._param_stream.backward(self._stream_scale)
         self._in_forward = False
         self.timers(BACKWARD_GLOBAL_TIMER).stop(sync=False)
         return loss
@@ -852,14 +884,101 @@ class DeepSpeedEngine:
         )
 
     def _offload_enabled(self) -> bool:
-        off = self._config.zero_config.offload_optimizer
-        requested = off is not None and str(off.device) not in ("none", "OffloadDeviceEnum.none")
+        requested = self._offload_requested(self._config.zero_config.offload_optimizer)
         if requested and self._config.zero_optimization_stage < 1:
             raise ValueError(
                 "offload_optimizer requires ZeRO stage >= 1 (stage 0 keeps full "
                 "optimizer state on device; set zero_optimization.stage)"
             )
         return requested
+
+    @staticmethod
+    def _offload_requested(off) -> bool:
+        return off is not None and str(off.device) not in ("none", "OffloadDeviceEnum.none")
+
+    def _validate_host_adam(self, feature: str) -> None:
+        """Both offload paths run the native host Adam/AdamW; they own the
+        update rule, so the configured optimizer must be an adam variant and
+        there can be no client optimizer."""
+        opt_cfg = self._config.optimizer_config
+        opt_type = opt_cfg.type.lower() if opt_cfg is not None and opt_cfg.type else C.ADAM_OPTIMIZER
+        if opt_type not in (C.ADAM_OPTIMIZER, C.ADAMW_OPTIMIZER, C.FUSED_ADAM_OPTIMIZER, C.CPU_ADAM_OPTIMIZER):
+            raise ValueError(
+                f"{feature} runs the host Adam/AdamW (DeepSpeedCPUAdam analog); "
+                f"configured optimizer {opt_type!r} is unsupported — use an adam "
+                f"variant or disable {feature}"
+            )
+        if self.client_optimizer is not None:
+            raise ValueError(
+                f"{feature} is incompatible with a client optimizer: the host "
+                "offload path owns the update rule (Adam/AdamW)"
+            )
+
+    def _param_offload_enabled(self) -> bool:
+        requested = self._offload_requested(self._config.zero_config.offload_param)
+        if requested and self._config.zero_optimization_stage != 3:
+            raise ValueError(
+                "offload_param requires ZeRO stage 3 (set zero_optimization.stage=3); "
+                f"got stage {self._config.zero_optimization_stage}"
+            )
+        return requested
+
+    def _init_param_stream(self, batch: Any) -> None:
+        """ZeRO-Infinity parameter offload: the model's layers live in host
+        DRAM or on local SSD and stream through HBM one layer at a time
+        (``runtime/zero/param_offload.py``; reference:
+        ``deepspeed/runtime/zero/stage3.py:542`` tensor swapping +
+        ``partitioned_param_swapper.py:36``). Replaces the jitted monolithic
+        step — model size is bounded by host memory, not HBM."""
+        from deepspeed_tpu.runtime.zero.param_offload import ParamStreamEngine
+
+        opt_cfg = self._config.optimizer_config
+        self._validate_host_adam("offload_param")
+        sharded_axes = {
+            ax: self.topology.axis_size(ax)
+            for ax in ("model", "sequence", "pipe", "expert")
+            if self.topology.axis_size(ax) > 1
+        }
+        if sharded_axes:
+            raise ValueError(
+                "offload_param layer streaming currently supports pure data "
+                f"parallelism; mesh has non-trivial axes {sharded_axes} whose "
+                "shardings it would silently drop (streamed layers are "
+                "replicated per chip)"
+            )
+        if self._pending_model_parameters is not None:
+            params = self._pending_model_parameters
+        else:
+            # init params on the host when a cpu backend exists (the whole
+            # point is that the model may not fit in HBM)
+            try:
+                host = jax.local_devices(backend="cpu")[0]
+            except RuntimeError:
+                host = None
+            if host is not None:
+                with jax.default_device(host):
+                    params = self.module.init(self._rng, batch)
+            else:
+                params = self.module.init(self._rng, batch)
+        self._param_stream = ParamStreamEngine(
+            self.module,
+            params,
+            self.topology,
+            self._config.zero_config,
+            dict(opt_cfg.params) if opt_cfg is not None else {},
+            self.compute_dtype,
+            fp16=self._config.fp16_enabled,
+            act_offload=self._config.activation_checkpointing_config.cpu_checkpointing,
+        )
+        del params
+        self._pending_model_parameters = None
+        self._scale_state = jax.device_put(self.loss_scaler.init_state())
+        self._fused_step_enabled = False
+        self._initialized = True
+        log_dist(
+            f"Initialized param-offload state: {self._param_stream.num_parameters():,} parameters",
+            ranks=[0],
+        )
 
     def _take_offload_step(self, lr: float) -> None:
         """Host-optimizer step (ZeRO-Offload): device computes grad stats,
@@ -915,6 +1034,19 @@ class DeepSpeedEngine:
             self._finish_step_bookkeeping(overflow_flag)
             return
         lr = self.optimizer.param_groups[0]["lr"]
+        if self._param_stream is not None:
+            grad_norm, overflow = self._param_stream.step(
+                lr,
+                float(jax.device_get(self._scale_state.scale)),
+                self._config.gradient_clipping,
+            )
+            self._last_grad_norm = jnp.float32(grad_norm)
+            self._scale_state = self.loss_scaler.update(
+                self._scale_state, jnp.asarray(overflow)
+            )
+            self._overflow = overflow
+            self._finish_step_bookkeeping(overflow)
+            return
         if self._host_offload is not None:
             self._take_offload_step(lr)  # sets self._overflow itself
             self._finish_step_bookkeeping(self._overflow)
@@ -1008,17 +1140,25 @@ class DeepSpeedEngine:
         self._validate_checkpoint_tag(tag)
         path = self._ckpt_dir(save_dir, tag)
         self.checkpoint_engine.create(tag)
-        if self._host_offload is not None:
+        if self._param_stream is not None:
+            # fp32 master + moments are the streamer's host state; module
+            # weights are the host-backed compute-dtype store
+            master = None
+            optimizer_state = {"param_stream": self._param_stream.state_dict()}
+            module_state = self._param_stream.gathered_params()
+        elif self._host_offload is not None:
             # the fp32 master lives inside the host-offload state dict; a
             # second device-side copy would double checkpoint size AND
             # materialize fp32 master in HBM (the memory offload avoids)
             master = None
             optimizer_state = {"host_offload": self._host_offload.state_dict()}
+            module_state = self._params
         else:
             master = self._master if self.mixed_precision else None
             optimizer_state = _namedtuple_to_dict(self._opt_state)
+            module_state = self._params
         state = {
-            "module": self._params,
+            "module": module_state,
             "master": master,
             "optimizer": optimizer_state,
             "loss_scaler": _namedtuple_to_dict(self._scale_state),
@@ -1075,6 +1215,30 @@ class DeepSpeedEngine:
                 "engine state must be initialized before load_checkpoint (call init_params "
                 "with a sample batch, or run one forward)"
             )
+        if self._param_stream is not None:
+            opt_state = state.get("optimizer")
+            if not (isinstance(opt_state, dict) and "param_stream" in opt_state):
+                raise NotImplementedError(
+                    "param-offload load_checkpoint requires a checkpoint saved "
+                    "by the param-offload engine (optimizer['param_stream'])"
+                )
+            if load_optimizer_states and not load_module_only:
+                self._param_stream.load_state_dict(opt_state["param_stream"])
+            else:
+                # weights only: fresh moments + step count
+                self._param_stream.load_master_state(opt_state["param_stream"])
+            if state.get("loss_scaler") is not None:
+                self._scale_state = jax.device_put(
+                    _dict_to_namedtuple(state["loss_scaler"], LossScaleState)
+                )
+            if load_lr_scheduler_states and self.lr_scheduler is not None and state.get("lr_scheduler"):
+                self.lr_scheduler.load_state_dict(state["lr_scheduler"])
+            if not load_module_only:
+                self.global_steps = state.get("global_steps", 0)
+                self.global_samples = state.get("global_samples", 0)
+                self.micro_steps = state.get("micro_steps", 0)
+                self.skipped_steps = state.get("skipped_steps", 0)
+            return path, state.get("client_state", {})
         put_p = jax.jit(lambda t: t, out_shardings=self._param_shardings)
         self._params = put_p(jax.tree_util.tree_map(jnp.asarray, state["module"]))
         if self._host_offload is not None:
@@ -1100,11 +1264,30 @@ class DeepSpeedEngine:
         elif self.mixed_precision and state.get("master") is not None:
             put_m = jax.jit(lambda t: t, out_shardings=self._master_shardings)
             self._master = put_m(jax.tree_util.tree_map(jnp.asarray, state["master"]))
-        elif not self.mixed_precision:
+        elif self.mixed_precision:
+            # checkpoint carries no fp32 master (saved by an offload engine or
+            # module-only): rebuild it from the loaded module weights, or the
+            # next step would cast the stale init-time master over them
+            put_m = jax.jit(
+                lambda t: jax.tree_util.tree_map(lambda x: x.astype(jnp.float32), t),
+                out_shardings=self._master_shardings,
+            )
+            self._master = put_m(self._params)
+        else:
             self._master = self._params
         if load_optimizer_states and not load_module_only and state.get("optimizer") is not None:
             if self._host_offload is not None:
                 self._host_offload.load_state_dict(state["optimizer"]["host_offload"])
+            elif isinstance(state["optimizer"], dict) and (
+                "param_stream" in state["optimizer"] or "host_offload" in state["optimizer"]
+            ):
+                kind = "param_stream" if "param_stream" in state["optimizer"] else "host_offload"
+                raise NotImplementedError(
+                    f"this checkpoint's optimizer state was saved by the {kind} "
+                    "offload engine and cannot be loaded into a non-offload "
+                    "engine; pass load_optimizer_states=False to adopt the "
+                    "module weights with a fresh optimizer"
+                )
             else:
                 opt = _dict_to_namedtuple(state["optimizer"], type(self._opt_state))
                 put_o = jax.jit(lambda t: t, out_shardings=self._opt_shardings)
@@ -1127,6 +1310,8 @@ class DeepSpeedEngine:
     # introspection / utils
     # ------------------------------------------------------------------
     def get_params(self):
+        if self._param_stream is not None:
+            return self._param_stream.gathered_params()
         return self._params
 
     def get_last_grads(self):
@@ -1137,6 +1322,8 @@ class DeepSpeedEngine:
         batch at the CURRENT (post-update) params and loss scale — close to
         but not identical to what the step consumed (in particular, after an
         fp16 overflow this reflects the reverted params and the new scale)."""
+        if self._param_stream is not None:
+            return self._param_stream.debug_grads()
         if not self._fused_step_enabled:
             return self._grad_acc
         if self._last_batch is None:
@@ -1160,6 +1347,8 @@ class DeepSpeedEngine:
         )
 
     def get_master_params(self):
+        if self._param_stream is not None:
+            return self._param_stream.master_params()
         if self._host_offload is not None:
             return self._host_offload.unflatten(self._host_offload.master_leaves())
         return self._master
@@ -1167,6 +1356,8 @@ class DeepSpeedEngine:
     def num_parameters(self) -> int:
         if not self._initialized:
             return 0
+        if self._param_stream is not None:
+            return self._param_stream.num_parameters()
         tree = self._params if self._master is None else self._master
         return sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(tree))
 

@@ -27,7 +27,8 @@ worth of streamed params, the activation stash (optionally host-offloaded,
 size is bounded by host DRAM/SSD, not HBM: the ZeRO-Infinity scaling claim.
 
 Works with any model family exposing ``stream_fns()`` (embed/layer/head
-programs + stacked layer params), which the built-in transformer families do.
+programs + stacked layer params) — the built-in dense ``TransformerLM`` does;
+MoE families raise (expert params live outside the stacked layer tree).
 """
 
 from __future__ import annotations
@@ -132,7 +133,13 @@ class LayerParamStore:
         self._pending[slot] = True
 
     def get_layer(self, i: int):
-        """Host view of layer ``i``'s param tree (blocks on any pending read)."""
+        """Host tree of layer ``i`` (blocks on any pending read).
+
+        NVMe mode returns views into an OWNED copy of the staged bytes, not
+        the staging buffer itself: ``jax.device_put`` may alias host memory
+        (zero-copy on the cpu backend) and the slot is overwritten by a later
+        prefetch — handing out live staging views corrupts in-flight layers
+        whenever ``n_layers > buffer_count``."""
         if self._dram is not None:
             return self._dram[i]
         slot = self._buf_slot(i)
@@ -141,7 +148,7 @@ class LayerParamStore:
         if self._pending[slot]:
             self._read_handles[slot].wait()
             self._pending[slot] = False
-        return self._unpack(self._staging[slot])
+        return self._unpack(self._staging[slot].copy())
 
     def update_layer(self, i: int, new_tree) -> None:
         """Write back an updated layer (async on NVMe; caller flush()es)."""
@@ -166,14 +173,22 @@ class LayerParamStore:
 
 
 class _HostLeafState:
-    """fp32 master + Adam moments for the flattened leaves of one layer."""
+    """fp32 master + Adam moments for the flattened leaves of one layer.
+
+    Moments allocate lazily at the first optimizer step so inference-only
+    engines never pay the 2× fp32 host cost."""
 
     __slots__ = ("master", "exp_avg", "exp_avg_sq")
 
     def __init__(self, flat_master: np.ndarray):
         self.master = flat_master
-        self.exp_avg = np.zeros_like(flat_master)
-        self.exp_avg_sq = np.zeros_like(flat_master)
+        self.exp_avg: Optional[np.ndarray] = None
+        self.exp_avg_sq: Optional[np.ndarray] = None
+
+    def ensure_moments(self) -> None:
+        if self.exp_avg is None:
+            self.exp_avg = np.zeros_like(self.master)
+            self.exp_avg_sq = np.zeros_like(self.master)
 
 
 class ParamStreamEngine:
@@ -189,12 +204,7 @@ class ParamStreamEngine:
         compute_dtype,
         fp16: bool = False,
         act_offload: bool = False,
-        gas: int = 1,
     ):
-        if not native_adam_available():
-            raise RuntimeError(
-                "offload_param requires the native cpu_adam op (g++ build failed?)"
-            )
         if not hasattr(module, "stream_fns"):
             raise ValueError(
                 "offload_param needs a layer-streamable model: the module must "
@@ -207,7 +217,6 @@ class ParamStreamEngine:
         self.compute_dtype = compute_dtype
         self.fp16 = fp16
         self.act_offload = act_offload
-        self.gas = gas
         off = zero_config.offload_param
         self.embed_fwd, self.layer_fwd, self.head_loss = module.stream_fns()
 
@@ -260,12 +269,11 @@ class ParamStreamEngine:
             buffer_count=int(getattr(off, "buffer_count", 2) or 2),
         )
 
-        self.adam = NativeCPUAdam(
-            betas=tuple(optimizer_params.get("betas", (0.9, 0.999))),
-            eps=optimizer_params.get("eps", 1e-8),
-            weight_decay=optimizer_params.get("weight_decay", 0.0),
-            adamw_mode=optimizer_params.get("adam_w_mode", True),
-        )
+        # the native optimizer builds lazily at the first step() so
+        # inference-only use neither requires the cpu_adam build nor pays
+        # for moment allocation
+        self._optimizer_params = dict(optimizer_params)
+        self._adam: Optional[NativeCPUAdam] = None
         self.step_count = 0
 
         # host fp32 grad accumulators (layer-major, + resident)
@@ -286,6 +294,22 @@ class ParamStreamEngine:
             ranks=[0],
         )
 
+    @property
+    def adam(self) -> NativeCPUAdam:
+        if self._adam is None:
+            if not native_adam_available():
+                raise RuntimeError(
+                    "offload_param training requires the native cpu_adam op "
+                    "(g++ build failed?)"
+                )
+            self._adam = NativeCPUAdam(
+                betas=tuple(self._optimizer_params.get("betas", (0.9, 0.999))),
+                eps=self._optimizer_params.get("eps", 1e-8),
+                weight_decay=self._optimizer_params.get("weight_decay", 0.0),
+                adamw_mode=self._optimizer_params.get("adam_w_mode", True),
+            )
+        return self._adam
+
     # ------------------------------------------------------------------
     # jitted programs (built lazily, cached by shape via jax.jit)
     # ------------------------------------------------------------------
@@ -301,8 +325,15 @@ class ParamStreamEngine:
         def j_layer(layer_p, h, positions, rng):
             return layer_fwd(layer_p, h, positions, rng)
 
+        def j_layer_eval(layer_p, h, positions):
+            return layer_fwd(layer_p, h, positions, None, train=False)
+
         def j_head(resident, h, labels, scale):
             return head_loss(resident, h, labels) * scale
+
+        def j_head_eval(resident, h, labels):
+            # labels=None → the model's head returns logits (inference)
+            return head_loss(resident, h, labels)
 
         def j_head_bwd(resident, h, labels, scale):
             (loss), vjp = jax.vjp(lambda r, x: head_loss(r, x, labels) * scale, resident, h)
@@ -324,7 +355,9 @@ class ParamStreamEngine:
         self._jit_cache = {
             "embed": jax.jit(j_embed),
             "layer": jax.jit(j_layer, out_shardings=None),
+            "layer_eval": jax.jit(j_layer_eval),
             "head": jax.jit(j_head),
+            "head_eval": jax.jit(j_head_eval),
             "head_bwd": jax.jit(j_head_bwd, out_shardings=(None, None, repl)),
             "layer_bwd": jax.jit(j_layer_bwd, out_shardings=(None, repl)),
             "embed_bwd": jax.jit(j_embed_bwd, out_shardings=repl),
@@ -338,6 +371,27 @@ class ParamStreamEngine:
     # ------------------------------------------------------------------
     # forward / backward / step
     # ------------------------------------------------------------------
+    def _stream_layers(self, h, positions, rng, train: bool, stash: bool):
+        """The double-buffered layer stream: prefetch layer ``i+1`` (disk →
+        host staging AND host → device) while layer ``i`` computes."""
+        progs = self._programs()
+        self.store.start_fetch(0)
+        dev_next = self._put_layer(0) if self.n_layers else None
+        for i in range(self.n_layers):
+            self.store.start_fetch(i + 1)
+            dev_i, dev_next = dev_next, None
+            if stash:
+                self._stash_act(h)
+            if train:
+                h_out = progs["layer"](dev_i, h, positions, jax.random.fold_in(rng, i))
+            else:
+                h_out = progs["layer_eval"](dev_i, h, positions)
+            if i + 1 < self.n_layers:
+                dev_next = self._put_layer(i + 1)  # overlaps layer i compute
+            h = h_out
+            del dev_i
+        return h
+
     def forward(self, tokens, labels, rng, scale: float):
         progs = self._programs()
         positions = jnp.broadcast_to(
@@ -345,20 +399,23 @@ class ParamStreamEngine:
         )
         h = progs["embed"](self.resident, tokens)
         self._acts = []
-        self.store.start_fetch(0)
-        dev_next = self._put_layer(0)
-        for i in range(self.n_layers):
-            self.store.start_fetch(i + 1)
-            dev_i, dev_next = dev_next, None
-            self._stash_act(h)
-            h_out = progs["layer"](dev_i, h, positions, jax.random.fold_in(rng, i))
-            if i + 1 < self.n_layers:
-                dev_next = self._put_layer(i + 1)  # overlaps layer i compute
-            h = h_out
-            del dev_i
+        h = self._stream_layers(h, positions, rng, train=True, stash=True)
         loss = progs["head"](self.resident, h, labels, jnp.float32(scale))
         self._stash = (tokens, labels, positions, rng, h)
         return loss
+
+    def eval_forward(self, tokens, labels=None):
+        """Deterministic forward (train=False programs, no activation stash,
+        no loss scaling) — the stream-path analog of the engine's
+        ``_jit_eval``. With ``labels=None`` the head returns logits
+        (inference); otherwise the eval loss."""
+        progs = self._programs()
+        positions = jnp.broadcast_to(
+            jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :], tokens.shape
+        )
+        h = progs["embed"](self.resident, tokens)
+        h = self._stream_layers(h, positions, None, train=False, stash=False)
+        return progs["head_eval"](self.resident, h, labels)
 
     def _stash_act(self, h):
         if self.act_offload:
@@ -425,6 +482,7 @@ class ParamStreamEngine:
             self.step_count += 1
             for i in range(self.n_layers):
                 st = self._layer_state[i]
+                st.ensure_moments()
                 g = self._grad_acc[i] * coef
                 self.adam.step(st.master, g, st.exp_avg, st.exp_avg_sq,
                                step=self.step_count, lr=lr)
@@ -433,6 +491,7 @@ class ParamStreamEngine:
                 )
             if self._resident_state.master.size:
                 st = self._resident_state
+                st.ensure_moments()
                 g = self._grad_acc_res * coef
                 self.adam.step(st.master, g, st.exp_avg, st.exp_avg_sq,
                                step=self.step_count, lr=lr)
@@ -459,8 +518,14 @@ class ParamStreamEngine:
     # introspection / checkpoint
     # ------------------------------------------------------------------
     def gathered_params(self):
-        """Full compute-dtype param tree (host-backed stacked layers)."""
-        per_layer = [self.store.get_layer(i) for i in range(self.n_layers)]
+        """Full compute-dtype param tree (host-backed stacked layers).
+
+        Copies each layer out immediately: on the NVMe store ``get_layer``
+        returns views into staging buffers that later fetches reuse."""
+        per_layer = [
+            jax.tree_util.tree_map(np.array, self.store.get_layer(i))
+            for i in range(self.n_layers)
+        ]
         stacked = jax.tree_util.tree_map(lambda *ls: np.stack(ls), *per_layer)
         out = dict(jax.tree_util.tree_map(np.asarray, jax.device_get(self.resident)))
         out["layers"] = stacked
@@ -481,34 +546,60 @@ class ParamStreamEngine:
         n = sum(st.master.size for st in self._layer_state)
         return n + self._resident_state.master.size
 
+    @staticmethod
+    def _leaf_state_dict(st: _HostLeafState) -> Dict[str, np.ndarray]:
+        st.ensure_moments()
+        return {
+            "master": st.master.copy(),
+            "exp_avg": st.exp_avg.copy(),
+            "exp_avg_sq": st.exp_avg_sq.copy(),
+        }
+
     def state_dict(self) -> Dict[str, Any]:
         return {
             "step": self.step_count,
-            "layers": [
-                {
-                    "master": st.master.copy(),
-                    "exp_avg": st.exp_avg.copy(),
-                    "exp_avg_sq": st.exp_avg_sq.copy(),
-                }
-                for st in self._layer_state
-            ],
-            "resident": {
-                "master": self._resident_state.master.copy(),
-                "exp_avg": self._resident_state.exp_avg.copy(),
-                "exp_avg_sq": self._resident_state.exp_avg_sq.copy(),
-            },
+            "layers": [self._leaf_state_dict(st) for st in self._layer_state],
+            "resident": self._leaf_state_dict(self._resident_state),
         }
+
+    def debug_grads(self):
+        """Host fp32 grad accumulators as a param-shaped tree (the
+        ``safe_get_full_grad`` surface). Values are the raw scaled
+        accumulation of the current window (scale × Σ microbatches);
+        ``None`` when the window is empty (e.g. right after ``step()``)."""
+        if self._micro_in_window == 0:
+            return None
+        per_layer = [self._unflatten_layer(acc) for acc in self._grad_acc]
+        stacked = jax.tree_util.tree_map(lambda *ls: np.stack(ls), *per_layer)
+        out = _unflatten_like(self.resident, self._grad_acc_res, jnp.float32)
+        out = jax.tree_util.tree_map(np.asarray, out)
+        out["layers"] = stacked
+        return out
+
+    def load_master_state(self, state: Dict[str, Any]) -> None:
+        """Module-only load: adopt the checkpoint's fp32 masters (and refresh
+        the compute store) with fresh moments and a reset step count."""
+        for st, rec in zip(self._layer_state, state["layers"]):
+            st.master[:] = np.asarray(rec["master"], np.float32)
+            st.exp_avg = None
+            st.exp_avg_sq = None
+        self._resident_state.master[:] = np.asarray(state["resident"]["master"], np.float32)
+        self._resident_state.exp_avg = None
+        self._resident_state.exp_avg_sq = None
+        self.step_count = 0
+        self._materialize_from_master()
+
+    @staticmethod
+    def _load_leaf_state(st: _HostLeafState, rec: Dict[str, Any]) -> None:
+        st.master[:] = np.asarray(rec["master"], np.float32)
+        st.exp_avg = np.array(rec["exp_avg"], dtype=np.float32)
+        st.exp_avg_sq = np.array(rec["exp_avg_sq"], dtype=np.float32)
 
     def load_state_dict(self, state: Dict[str, Any]) -> None:
         self.step_count = int(state["step"])
         for st, rec in zip(self._layer_state, state["layers"]):
-            st.master[:] = np.asarray(rec["master"], np.float32)
-            st.exp_avg[:] = np.asarray(rec["exp_avg"], np.float32)
-            st.exp_avg_sq[:] = np.asarray(rec["exp_avg_sq"], np.float32)
-        rec = state["resident"]
-        self._resident_state.master[:] = np.asarray(rec["master"], np.float32)
-        self._resident_state.exp_avg[:] = np.asarray(rec["exp_avg"], np.float32)
-        self._resident_state.exp_avg_sq[:] = np.asarray(rec["exp_avg_sq"], np.float32)
+            self._load_leaf_state(st, rec)
+        self._load_leaf_state(self._resident_state, state["resident"])
         self._materialize_from_master()
 
     def _materialize_from_master(self) -> None:
