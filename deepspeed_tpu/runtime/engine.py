@@ -212,6 +212,7 @@ class DeepSpeedEngine:
         self._profile_fn = None
         self._last_batch = None
         self._last_fwd_rng = None
+        self._last_fwd_scale = None
         self._jit_debug_grad = None
         self._jit_fwd_bwd = None
         self._jit_eval = None
@@ -276,6 +277,15 @@ class DeepSpeedEngine:
         return (self.micro_steps + 1) % self.gradient_accumulation_steps() == 0
 
     def train(self, mode: bool = True):
+        if not mode and self._pending_commit is not None:
+            raise RuntimeError(
+                "eval() called with a pending fused step: with "
+                "gradient_accumulation_steps=1 forward() already applied the "
+                "optimizer update; call step() before switching to eval"
+            )
+        if not mode and self._training_mode:
+            # a half-open throughput window would count eval wall-clock
+            self.tput_timer.abort_window()
         self._training_mode = mode
         return self
 
@@ -496,6 +506,10 @@ class DeepSpeedEngine:
                 return out[0]
             return out
 
+        # the debug-grad surface (get_last_grads) must differentiate the SAME
+        # loss contract the step uses
+        self._loss_of = loss_of
+
         def fwd_bwd(params, grad_acc, scale, rng, batch):
             def scaled_loss(p):
                 return loss_of(p, batch, rng) * scale.astype(jnp.float32)
@@ -692,7 +706,9 @@ class DeepSpeedEngine:
         if not self._initialized:
             self.init_params(batch)
         self.timers(FORWARD_GLOBAL_TIMER).start()
-        self.tput_timer.start()
+        if self._training_mode:
+            # eval forwards must not open/extend a throughput window
+            self.tput_timer.start()
         if self.curriculum_scheduler is not None and self._training_mode:
             seqlen = self.curriculum_scheduler.update_difficulty(self.global_steps + 1)
             batch = _truncate_seq(batch, seqlen)
@@ -751,9 +767,12 @@ class DeepSpeedEngine:
                 self._params = self._master
             self._pending_commit = (norm, ovf)
             # host-side batch reference only (no HBM pin) for the on-demand
-            # debug-grad surface (get_last_grads)
+            # debug-grad surface (get_last_grads); the pre-update scale array
+            # is NOT donated, so stashing it keeps the exact scale the step
+            # consumed even after a dynamic-loss-scale update
             self._last_batch = batch
             self._last_fwd_rng = parent_rng
+            self._last_fwd_scale = fwd_args[3 if self.mixed_precision else 2].scale
             self._last_loss = loss
             self._in_forward = True
         elif self._training_mode:
@@ -1135,6 +1154,12 @@ class DeepSpeedEngine:
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None, client_state: Optional[Dict] = None, save_latest: bool = True, exclude_frozen_parameters: bool = False):  # noqa: ARG002
         if not self._initialized:
             raise RuntimeError("cannot save before the engine state is initialized")
+        if self._pending_commit is not None:
+            raise RuntimeError(
+                "save_checkpoint() called with a pending fused step: forward() "
+                "already applied the optimizer update but step() has not adopted "
+                "it (counters/lr would be inconsistent); call step() first"
+            )
         if tag is None:
             tag = f"global_step{self.global_steps}"
         self._validate_checkpoint_tag(tag)
@@ -1319,9 +1344,10 @@ class DeepSpeedEngine:
         surface behind ``safe_get_full_grad``). On the accumulating path this
         is the live fp32 accumulator; on the fused path grads only exist
         inside the step program, so they are recomputed here on the stashed
-        batch at the CURRENT (post-update) params and loss scale — close to
-        but not identical to what the step consumed (in particular, after an
-        fp16 overflow this reflects the reverted params and the new scale)."""
+        batch with the exact rng and loss scale the step consumed — but at
+        the CURRENT (post-update) params, so values differ from the step's
+        grads by one optimizer update (and after an fp16 overflow reflect the
+        reverted params)."""
         if self._param_stream is not None:
             return self._param_stream.debug_grads()
         if not self._fused_step_enabled:
@@ -1329,13 +1355,11 @@ class DeepSpeedEngine:
         if self._last_batch is None:
             return None
         if self._jit_debug_grad is None:
-            module = self.module
+            loss_of = self._loss_of  # the step's own loss contract
 
             def dbg(params, rng, scale, batch):
                 def scaled_loss(p):
-                    out = module.apply(p, batch, rngs={"dropout": rng}, train=True)
-                    loss = out[0] if isinstance(out, tuple) else out
-                    return loss * scale.astype(jnp.float32)
+                    return loss_of(p, batch, rng) * scale.astype(jnp.float32)
 
                 g = jax.grad(scaled_loss)(params)
                 return jax.tree_util.tree_map(lambda x: x.astype(jnp.float32), g)
@@ -1343,7 +1367,7 @@ class DeepSpeedEngine:
             self._jit_debug_grad = jax.jit(dbg)
         _, sub = jax.random.split(self._last_fwd_rng)
         return self._jit_debug_grad(
-            self._params, sub, self._scale_state.scale, self._place_batch(self._last_batch)
+            self._params, sub, self._last_fwd_scale, self._place_batch(self._last_batch)
         )
 
     def get_master_params(self):

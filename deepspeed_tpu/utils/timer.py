@@ -137,6 +137,11 @@ class ThroughputTimer:
         self.epoch_count += 1
         self.micro_step_count = 0
 
+    def abort_window(self):
+        """Discard a half-open measurement window (e.g. the engine switches
+        to eval mid-window) so its wall-clock never deflates the rate."""
+        self._window_open = False
+
     def start(self):
         self.started = True
         if not self._window_open and self.global_step_count >= self.start_step:

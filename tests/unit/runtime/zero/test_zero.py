@@ -79,32 +79,54 @@ def test_zero3_shards_params(eight_devices):
     assert "data" in str(engine.get_params()["w0"].sharding.spec)
 
 
-def test_gradient_accumulation_equivalence(eight_devices):
-    """gas=2 with half micro-batch == gas=1 full batch (same total tokens)."""
-    _, losses_gas1 = _train(1, steps=3, gas=1)
-    # gas=2: the same data split into two micro-batches per step
+@pytest.mark.parametrize("stage", [0, 1, 2, 3])
+def test_gradient_accumulation_equivalence(stage, eight_devices):
+    """gas=2 with half micro-batches matches gas=1 full batch on the SAME
+    token stream — i.e. the fused single-program path (gas=1) and the
+    accumulating path (gas>1) implement the same math, per stage. Deleting
+    either path's numerics (not just its speed) must fail this test."""
     import deepspeed_tpu.parallel.mesh as mesh_mod
 
-    mesh_mod.reset_topology()
-    config = {
-        "train_micro_batch_size_per_gpu": 1,
-        "gradient_accumulation_steps": 2,
-        "optimizer": {"type": "adam", "params": {"lr": 1e-2, "weight_decay": 0.01}},
-        "bf16": {"enabled": True},
-        "zero_optimization": {"stage": 1},
-        "gradient_clipping": 1.0,
-    }
-    engine, *_ = ds.initialize(model=SimpleModel(64), config=config)
-    # global micro batch = micro_per_chip(1) × dp(8) = 8 rows
-    data = list(random_dataloader(64, total_samples=3 * 16, batch_size=16))
-    for batch in data:
-        x, y = batch
-        for half in range(2):
-            sub = (x[half * 8 : (half + 1) * 8], y[half * 8 : (half + 1) * 8])
-            loss = engine(sub)
-            engine.backward(loss)
-            engine.step()
-    assert engine.global_steps == 3
+    steps = 3
+    data = list(random_dataloader(64, total_samples=steps * 16, batch_size=16))
+
+    def run(gas):
+        mesh_mod.reset_topology()
+        config = {
+            "train_micro_batch_size_per_gpu": 2 // gas,
+            "gradient_accumulation_steps": gas,
+            "optimizer": {"type": "adam", "params": {"lr": 1e-2, "weight_decay": 0.01}},
+            "zero_optimization": {"stage": stage},
+            "gradient_clipping": 1.0,
+        }
+        engine, *_ = ds.initialize(model=SimpleModel(64), config=config)
+        step_losses = []
+        micro = 16 // gas
+        for x, y in data:
+            micro_losses = []
+            for g in range(gas):
+                sub = (x[g * micro : (g + 1) * micro], y[g * micro : (g + 1) * micro])
+                loss = engine(sub)
+                engine.backward(loss)
+                engine.step()
+                micro_losses.append(float(jax.device_get(loss)))
+            step_losses.append(sum(micro_losses) / len(micro_losses))
+        assert engine.global_steps == steps
+        # confirm the intended code paths actually ran
+        assert engine._fused_step_enabled == (gas == 1)
+        master = jax.tree_util.tree_map(
+            lambda a: np.asarray(jax.device_get(a)), engine.get_master_params()
+        )
+        return step_losses, master
+
+    losses_gas1, master_gas1 = run(1)
+    losses_gas2, master_gas2 = run(2)
+    # fp32: summation-order differences only
+    np.testing.assert_allclose(losses_gas1, losses_gas2, rtol=1e-4, atol=1e-6)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(master_gas1), jax.tree_util.tree_leaves(master_gas2)
+    ):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)
 
 
 def test_estimate_zero_memory():
