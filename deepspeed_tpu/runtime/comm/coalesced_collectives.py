@@ -61,6 +61,39 @@ def reduce_scatter_coalesced(
     return shards
 
 
+def quant_a2a_reduce_local(
+    flat: jnp.ndarray, axis_name: str, world: int, gpg: int, num_bits: int
+) -> jnp.ndarray:
+    """Inside ``shard_map``: quantize this chip's contribution per destination
+    chunk, all-to-all the int8 payload + scales, dequantize and sum — the qgZ
+    wire pattern shared by ``quantized_reduce_scatter`` and the ZeRO++ grad
+    path. ``flat`` [n] with n divisible by world×gpg; returns this chip's
+    summed chunk [n/world] in fp32."""
+    n = flat.shape[0]
+    q, scale = quantize(flat.reshape(world, n // world), world * gpg, num_bits)
+    q = q.reshape(world, gpg, -1)
+    scale = scale.reshape(world, gpg)
+    q_recv = jax.lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0, tiled=False)
+    s_recv = jax.lax.all_to_all(scale, axis_name, split_axis=0, concat_axis=0, tiled=False)
+    deq = q_recv.astype(jnp.float32) * s_recv[..., None]
+    return jnp.sum(deq, axis=0).reshape(-1)
+
+
+def quant_all_gather_local(
+    x: jnp.ndarray, axis_name: str, num_groups: int, num_bits: int
+) -> jnp.ndarray:
+    """Inside ``shard_map``: quantize the local array, all-gather int8 +
+    scales, dequantize — the qwZ wire pattern shared by
+    ``quantized_all_gather`` and the ZeRO++ param gathers. Returns
+    [world, x.size] fp32 (one dequantized row per source chip)."""
+    q, scale = quantize(x, num_groups, num_bits)
+    qg = jax.lax.all_gather(q, axis_name, axis=0, tiled=False)
+    sg = jax.lax.all_gather(scale, axis_name, axis=0, tiled=False)
+    world = qg.shape[0]
+    deq = qg.astype(jnp.float32) * sg[..., None]
+    return deq.reshape(world, x.size)
+
+
 def quantized_reduce_scatter(
     tensor: jnp.ndarray,
     mesh: Mesh,
@@ -79,18 +112,10 @@ def quantized_reduce_scatter(
     n = flat.shape[0]
 
     def body(x):
-        # x: this chip's full local copy [n] (replicated input); chunk it
-        # per destination, quantize each chunk, exchange, reduce
-        chunks = x.reshape(world, n // world)
-        q, scale = quantize(chunks, world * groups_per_shard, num_bits)
-        q = q.reshape(world, groups_per_shard, -1)
-        scale = scale.reshape(world, groups_per_shard)
-        q_recv = jax.lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0, tiled=False)
-        s_recv = jax.lax.all_to_all(scale, axis_name, split_axis=0, concat_axis=0, tiled=False)
-        # q_recv: [world, groups, chunk/groups] — contributions from every
-        # source chip for MY shard; dequantize and sum
-        deq = q_recv.astype(jnp.float32) * s_recv[..., None]
-        return jnp.sum(deq, axis=0).reshape(1, n // world)
+        # x: this chip's full local copy [n] (replicated input)
+        return quant_a2a_reduce_local(
+            x, axis_name, world, groups_per_shard, num_bits
+        ).reshape(1, n // world)
 
     out = jax.shard_map(
         body,
@@ -115,12 +140,7 @@ def quantized_all_gather(
 
     def body(x):
         # x: local shard
-        q, scale = quantize(x, num_groups, num_bits)
-        qg = jax.lax.all_gather(q, axis_name, axis=0, tiled=False)
-        sg = jax.lax.all_gather(scale, axis_name, axis=0, tiled=False)
-        world = qg.shape[0]
-        deq = qg.astype(jnp.float32) * sg[..., None]
-        return deq.reshape(world * x.size)
+        return quant_all_gather_local(x, axis_name, num_groups, num_bits).reshape(-1)
 
     local_shape = (shard.shape[0],) + shard.shape[1:]
     out = jax.shard_map(

@@ -96,6 +96,38 @@ class MeshConfig(DeepSpeedConfigModel):
         )
 
 
+def split_data_axis(mc: "MeshConfig", group_size: int, n_devices: int, feature: str) -> None:
+    """Split the data axis into data(inner) × data_outer so ZeRO shards
+    within groups of ``group_size`` ranks and replicates across groups.
+    Shared by MiCS (``mics_shard_size``) and ZeRO++ hpZ
+    (``zero_hpz_partition_size``). ``group_size`` counts ALL sharding ranks,
+    so expert×sequence (always inside the group) divide it first. A mesh the
+    user already split explicitly must agree with the requested group size."""
+    fixed = mc.model * mc.sequence * mc.expert * mc.pipe
+    inner_fixed = mc.expert * mc.sequence
+    if group_size % inner_fixed != 0:
+        raise ValueError(
+            f"{feature}={group_size} must be a multiple of expert×sequence={inner_fixed} "
+            "(those axes are always inside the shard group)"
+        )
+    data_inner = group_size // inner_fixed
+    if mc.data_outer > 1:
+        if mc.data != data_inner:
+            raise ValueError(
+                f"{feature}={group_size} (data slice {data_inner}) conflicts with the "
+                f"explicitly split mesh (data={mc.data}, data_outer={mc.data_outer})"
+            )
+        return
+    data_total = mc.data or (n_devices // fixed // mc.data_outer)
+    if data_inner <= 0 or data_total % data_inner != 0:
+        raise ValueError(
+            f"{feature}={group_size} (data slice {data_inner}) does not divide "
+            f"the data axis {data_total}"
+        )
+    mc.data = data_inner
+    mc.data_outer = data_total // data_inner
+
+
 class CommsLoggerConfig(DeepSpeedConfigModel):
     enabled: bool = False
     verbose: bool = False

@@ -58,14 +58,16 @@ def shard_over_zero_axes(
     topo: Topology,
     base_spec: Optional[PartitionSpec] = None,
     threshold: int = 0,
+    axes: Optional[Tuple[str, ...]] = None,
 ) -> PartitionSpec:
     """Add ZeRO (data) sharding to ``base_spec`` (which may carry TP axes).
 
     Chooses the largest dim that is (a) not already sharded, (b) divisible by
     the ZeRO world size. Falls back to replicated if none qualifies or the
-    param is below ``threshold`` elements.
+    param is below ``threshold`` elements. ``axes`` overrides the topology's
+    default ZeRO axes (hpZ shards masters over more axes than params).
     """
-    zero_axes = topo.zero_shard_axes
+    zero_axes = axes if axes is not None else topo.zero_shard_axes
     zero_size = int(np.prod([topo.axis_size(a) for a in zero_axes]))
     entries = _spec_entries(base_spec, len(shape))
     if zero_size == 1:
@@ -101,6 +103,16 @@ class ZeroPartitioner:
         self.stage = int(zero_config.stage)
         self.topo = topo
         self.tp_spec_tree = tp_spec_tree
+        # hpZ: the bf16 param store shards only within the hpz group (the
+        # inner 'data' axis after the mesh split, reference
+        # partition_parameters.py:1490 secondary tensor) while master/grads
+        # stay on the full DP world — so master/grad specs add 'data_outer'.
+        self.hpz = int(getattr(zero_config, "zero_hpz_partition_size", 1) or 1) > 1
+
+    def _full_dp_axes(self) -> Optional[Tuple[str, ...]]:
+        if not self.hpz:
+            return None
+        return self.topo.data_parallel_axes
 
     def _tp_spec(self, path_spec) -> Optional[PartitionSpec]:
         return path_spec
@@ -129,7 +141,9 @@ class ZeroPartitioner:
 
         def fn(p, tp):
             if self.stage >= int(ZeroStageEnum.optimizer_states):
-                return shard_over_zero_axes(np.shape(p), self.topo, tp, threshold=0)
+                return shard_over_zero_axes(
+                    np.shape(p), self.topo, tp, threshold=0, axes=self._full_dp_axes()
+                )
             return PartitionSpec(*_spec_entries(tp, np.ndim(p)))
 
         return self._map(params, fn)
@@ -139,7 +153,9 @@ class ZeroPartitioner:
 
         def fn(p, tp):
             if self.stage >= int(ZeroStageEnum.gradients):
-                return shard_over_zero_axes(np.shape(p), self.topo, tp, threshold=0)
+                return shard_over_zero_axes(
+                    np.shape(p), self.topo, tp, threshold=0, axes=self._full_dp_axes()
+                )
             return PartitionSpec(*_spec_entries(tp, np.ndim(p)))
 
         return self._map(params, fn)

@@ -1,0 +1,187 @@
+"""ZeRO++ tests (reference: ``tests/unit/runtime/zero/test_zeropp.py``).
+
+qwZ: stage-3 param gathers carry int8 on the wire; training stays within
+quantization tolerance of exact stage 3. qgZ: the explicit quantized grad
+reduce matches the exact path within tolerance. hpZ: params shard over the
+secondary (intra-group) partition while masters keep the full DP sharding,
+with exact numerics.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+import deepspeed_tpu.parallel.mesh as mesh_mod
+from tests.unit.simple_model import SimpleModel, random_dataloader
+
+HIDDEN = 64
+
+
+def _train(zero_cfg, steps=5, bf16=False):
+    mesh_mod.reset_topology()
+    config = {
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+        # clipping makes the trajectory sensitive to any grad-scale error
+        # (e.g. forgetting the 1/world average of per-chip partials)
+        "gradient_clipping": 1.0,
+        "zero_optimization": zero_cfg,
+    }
+    if bf16:
+        config["bf16"] = {"enabled": True}
+    engine, *_ = ds.initialize(model=SimpleModel(HIDDEN), config=config)
+    losses = []
+    for batch in random_dataloader(HIDDEN, total_samples=steps * 8, batch_size=8):
+        loss = engine(batch)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(jax.device_get(loss)))
+    return engine, losses
+
+
+class TestQwZ:
+    def test_trains_within_quant_tolerance(self, eight_devices):
+        _, exact = _train({"stage": 3, "stage3_param_persistence_threshold": 0})
+        engine, quant = _train(
+            {
+                "stage": 3,
+                "stage3_param_persistence_threshold": 0,
+                "zero_quantized_weights": True,
+            }
+        )
+        assert quant[-1] < quant[0], "qwZ run did not learn"
+        np.testing.assert_allclose(quant, exact, rtol=0.05, atol=5e-3)
+        # int8 quantization must actually perturb the math (i.e. the flag is
+        # consumed, not ignored)
+        assert not np.allclose(quant, exact, rtol=1e-12, atol=0)
+
+    def test_int8_on_the_wire(self, eight_devices):
+        """The compiled program's param all-gather moves s8, not f32/bf16."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from deepspeed_tpu.parallel.mesh import initialize_topology
+        from deepspeed_tpu.runtime.zero.zeropp import qwz_gather_tree
+
+        mesh_mod.reset_topology()
+        topo = initialize_topology({})
+        mesh = topo.mesh
+        spec = P("data", None)
+        x = jax.device_put(
+            np.random.RandomState(0).randn(64, 32).astype(np.float32),
+            NamedSharding(mesh, spec),
+        )
+        fn = jax.jit(lambda p: qwz_gather_tree({"w": p}, {"w": spec}, topo)["w"].sum())
+        hlo = fn.lower(x).compile().as_text()
+        import re
+
+        # lines where the op itself is an all-gather (not fusions consuming one)
+        ag_ops = re.findall(r"= (\S+) all-gather\(", hlo)
+        assert any(t.startswith("s8[64,32]") for t in ag_ops), ag_ops
+        # the only wide-float gather allowed is the per-group scales
+        assert not any(
+            t.startswith(("f32[64,32]", "bf16[64,32]")) for t in ag_ops
+        ), f"param payload gather still moves wide floats: {ag_ops}"
+
+    def test_requires_stage3(self, eight_devices):
+        with pytest.raises(ValueError, match="stage 3"):
+            _train({"stage": 1, "zero_quantized_weights": True}, steps=1)
+
+
+class TestQgZ:
+    def test_trains_within_quant_tolerance(self, eight_devices):
+        engine_e, exact = _train({"stage": 3, "stage3_param_persistence_threshold": 0})
+        engine, quant = _train(
+            {
+                "stage": 3,
+                "stage3_param_persistence_threshold": 0,
+                "zero_quantized_gradients": True,
+            }
+        )
+        assert engine._fused_step_enabled is False  # explicit grad path in use
+        assert quant[-1] < quant[0], "qgZ run did not learn"
+        np.testing.assert_allclose(quant, exact, rtol=0.05, atol=5e-3)
+        assert not np.allclose(quant, exact, rtol=1e-12, atol=0)
+        # grad norms must agree in scale (catches missing 1/world averaging)
+        n_exact = engine_e.get_global_grad_norm()
+        n_quant = engine.get_global_grad_norm()
+        assert abs(n_quant - n_exact) / n_exact < 0.05, (n_quant, n_exact)
+
+    def test_combined_with_qwz(self, eight_devices):
+        _, exact = _train({"stage": 3, "stage3_param_persistence_threshold": 0})
+        _, quant = _train(
+            {
+                "stage": 3,
+                "stage3_param_persistence_threshold": 0,
+                "zero_quantized_weights": True,
+                "zero_quantized_gradients": True,
+            }
+        )
+        np.testing.assert_allclose(quant, exact, rtol=0.08, atol=1e-2)
+
+    def test_rejects_nondata_mesh(self):
+        mesh_mod.reset_topology()
+        config = {
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+            "mesh": {"model": 2},
+            "zero_optimization": {"stage": 3, "zero_quantized_gradients": True},
+        }
+        engine, *_ = ds.initialize(model=SimpleModel(HIDDEN), config=config)
+        batch = next(random_dataloader(HIDDEN, total_samples=8, batch_size=8))
+        with pytest.raises(ValueError, match="pure data-axis"):
+            engine(batch)
+
+
+class TestHpZ:
+    def test_secondary_partition_shardings(self, eight_devices):
+        engine, losses = _train(
+            {
+                "stage": 3,
+                "stage3_param_persistence_threshold": 0,
+                "zero_hpz_partition_size": 4,
+            },
+            bf16=True,
+        )
+        assert losses[-1] < losses[0]
+        # mesh split 8 = data(4) × data_outer(2)
+        assert engine.topology.axis_size("data") == 4
+        assert engine.topology.axis_size("data_outer") == 2
+        p_spec = str(engine.get_params()["w0"].sharding.spec)
+        m_spec = str(engine.get_master_params()["w0"].sharding.spec)
+        # the bf16 store shards within the group only (gathers stay local);
+        # the fp32 master shards over the full DP world
+        assert "data" in p_spec and "data_outer" not in p_spec, p_spec
+        assert "data_outer" in m_spec, m_spec
+
+    def test_matches_plain_stage3(self, eight_devices):
+        _, exact = _train(
+            {"stage": 3, "stage3_param_persistence_threshold": 0}, bf16=True
+        )
+        _, hpz = _train(
+            {
+                "stage": 3,
+                "stage3_param_persistence_threshold": 0,
+                "zero_hpz_partition_size": 4,
+            },
+            bf16=True,
+        )
+        # hpZ changes placement only, never math (bf16 reduction-order noise)
+        np.testing.assert_allclose(hpz, exact, rtol=1e-2, atol=1e-3)
+
+    def test_requires_mixed_precision(self):
+        with pytest.raises(ValueError, match="bf16/fp16"):
+            _train({"stage": 3, "zero_hpz_partition_size": 4}, steps=1)
+
+    def test_conflicts_with_mics(self):
+        with pytest.raises(ValueError, match="mics"):
+            _train(
+                {"stage": 3, "zero_hpz_partition_size": 4, "mics_shard_size": 4},
+                steps=1,
+                bf16=True,
+            )
+
+
+def test_unwired_nontrainable_key_raises():
+    with pytest.raises(NotImplementedError, match="nontrainable"):
+        _train({"stage": 3, "zero_quantized_nontrainable_weights": True}, steps=1)
