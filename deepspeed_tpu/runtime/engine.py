@@ -108,6 +108,16 @@ class DeepSpeedEngine:
         self.mpu = mpu
 
         self._config = config_class or DeepSpeedConfig(config if config is not None else {}, mpu)
+        if self._config.sparse_gradients_enabled:
+            # reference engine.py:2398 sparsifies embedding grads for the
+            # allreduce; under XLA embedding grads are dense scatter-adds and
+            # the reduction already rides reduce-scatter shardings, so the
+            # flag cannot do what it promises — reject rather than ignore
+            raise NotImplementedError(
+                "sparse_gradients is not supported by the TPU engine (XLA "
+                "embedding gradients are dense and already reduce-scattered); "
+                "remove the key"
+            )
         self._apply_mics_mesh()
         self._validate_zeropp_config()
         self.topology: Topology = get_topology() if _topology_matches(self._config) else initialize_topology(
@@ -1417,6 +1427,68 @@ class DeepSpeedEngine:
         return self._jit_debug_grad(
             self._params, sub, self._last_fwd_scale, self._place_batch(self._last_batch)
         )
+
+    def set_params(self, tree) -> None:
+        """Adopt a full param tree (host numpy or device arrays) as the new
+        model weights: refreshes the fp32 master AND the compute-dtype store
+        so the surgery survives the next optimizer step. The write-back half
+        of ``zero.GatheredParameters`` (reference re-partitioning on exit,
+        partition_parameters.py:1938). Optimizer moments are kept."""
+        if not self._initialized:
+            raise RuntimeError("set_params before engine state is initialized")
+        if self._param_stream is not None:
+            stream = self._param_stream
+            layers = tree["layers"]
+            for i in range(stream.n_layers):
+                per_layer = jax.tree_util.tree_map(lambda a: np.asarray(a)[i], layers)
+                flat = np.concatenate(
+                    [
+                        np.asarray(l, np.float32).ravel()
+                        for l in jax.tree_util.tree_leaves(per_layer)
+                    ]
+                )
+                stream._layer_state[i].master[:] = flat
+            resident = {k: v for k, v in tree.items() if k != "layers"}
+            if stream._resident_state.master.size:
+                stream._resident_state.master[:] = np.concatenate(
+                    [
+                        np.asarray(l, np.float32).ravel()
+                        for l in jax.tree_util.tree_leaves(resident)
+                    ]
+                )
+            stream._materialize_from_master()
+            return
+        master32 = jax.tree_util.tree_map(
+            lambda x: jnp.asarray(x, dtype=jnp.float32), tree
+        )
+        if self._host_offload is not None:
+            self._host_offload.set_master_leaves(jax.tree_util.tree_leaves(master32))
+            new_params = self._host_offload.unflatten(
+                [
+                    jnp.asarray(np.asarray(m), dtype=p.dtype)
+                    for m, p in zip(
+                        jax.tree_util.tree_leaves(master32),
+                        jax.tree_util.tree_leaves(self._params),
+                    )
+                ]
+            )
+            self._params = self._jit_reshard_params(new_params)
+            return
+        put_m = jax.jit(lambda t: t, out_shardings=self._master_shardings)
+        self._master = put_m(master32)
+        if self.mixed_precision:
+            keep32 = getattr(self, "_keep_fp32", None)
+            if keep32 is None:
+                cast = lambda t: jax.tree_util.tree_map(
+                    lambda x: x.astype(self.compute_dtype), t
+                )
+            else:
+                cast = lambda t: jax.tree_util.tree_map(
+                    lambda x, keep: x if keep else x.astype(self.compute_dtype), t, keep32
+                )
+            self._params = jax.jit(cast, out_shardings=self._param_shardings)(self._master)
+        else:
+            self._params = self._master
 
     def get_master_params(self):
         if self._param_stream is not None:

@@ -151,20 +151,26 @@ class HostOffloadAdam:
         self._param_dtypes = list(dtypes)
 
     def set_master_leaves(self, leaves: List[Any]) -> None:
-        """Overwrite the host master from device/host arrays (checkpoint load)."""
+        """Overwrite the host master from device/host arrays (checkpoint load,
+        GatheredParameters write-back). The per-device fast path applies only
+        when the incoming array's shard layout matches the master's; anything
+        else (host numpy, replicated or differently-sharded arrays) goes
+        through full-array slicing."""
         for li, leaf in enumerate(leaves):
             arr = leaf
             for sh in self._shards[li]:
+                placed = False
                 if hasattr(arr, "addressable_shards"):
                     for s in arr.addressable_shards:
-                        if s.device == sh.device:
+                        if s.device == sh.device and int(np.prod(s.data.shape)) == sh.master.size:
                             sh.master[:] = (
                                 np.asarray(jax.device_get(s.data), np.float32).ravel()
                             )
+                            placed = True
                             break
-                else:
+                if not placed:
                     sh.master[:] = (
-                        np.asarray(arr, np.float32)[sh.index].ravel()
+                        np.asarray(jax.device_get(arr), np.float32)[sh.index].ravel()
                     )
 
     def step(self, grad_leaves: List[Any], lr: float, inv_scale: float, clip_coef: float):

@@ -64,28 +64,67 @@ def shutdown_init_context() -> None:
 class GatheredParameters(contextlib.AbstractContextManager):
     """Yield replicated views of sharded params (reference :1938).
 
-    ``params`` is a pytree of jax.Arrays (possibly sharded). On enter, each is
-    fully gathered to a host numpy array; on exit with ``modifier_rank`` set,
-    mutated values are pushed back with the original shardings via the
-    ``write_back`` callback provided by the engine.
+    ``params`` is a pytree of jax.Arrays (possibly sharded) — or ``None``
+    with ``engine`` set, meaning the engine's full param tree. On enter,
+    leaves are fully gathered to host numpy arrays; on exit with
+    ``modifier_rank`` set, mutations are written back automatically:
+
+    * ``engine=...`` — the engine re-adopts the (whole) tree via
+      ``engine.set_params`` (master + compute store refreshed, the
+      reference's transparent re-partition on exit);
+    * ``write_back=...`` — custom callback escape hatch for partial trees.
+
+    Passing a partial tree with ``modifier_rank`` and no write-back path
+    raises: the mutation would otherwise be silently dropped.
     """
 
-    def __init__(self, params: Any, modifier_rank: Optional[int] = None, fwd_module=None, enabled: bool = True, write_back=None):  # noqa: ARG002
+    def __init__(self, params: Any = None, modifier_rank: Optional[int] = None, fwd_module=None, enabled: bool = True, write_back=None, engine=None):  # noqa: ARG002
+        self.engine = engine
+        if params is None:
+            if engine is None:
+                raise ValueError("GatheredParameters needs params or engine")
+            params = engine.get_params()
+            self._is_full_tree = True
+        else:
+            self._is_full_tree = engine is not None and (
+                jax.tree_util.tree_structure(params)
+                == jax.tree_util.tree_structure(engine.get_params())
+            )
         self.params = params
         self.modifier_rank = modifier_rank
         self.enabled = enabled
         self.write_back = write_back
         self.gathered = None
+        if (
+            enabled
+            and modifier_rank is not None
+            and write_back is None
+            and not self._is_full_tree
+        ):
+            raise ValueError(
+                "GatheredParameters(modifier_rank=...) on a partial tree has "
+                "no write-back path: pass the engine's full param tree (or "
+                "engine=..., or a write_back callback) so mutations stick"
+            )
 
     def __enter__(self):
         if not self.enabled:
             return self.params
-        self.gathered = jax.tree_util.tree_map(lambda p: jax.device_get(p), self.params)
+        import numpy as np
+
+        # np.array copy: device_get hands back read-only views
+        self.gathered = jax.tree_util.tree_map(
+            lambda p: np.array(jax.device_get(p)), self.params
+        )
         return self.gathered
 
     def __exit__(self, *exc):
-        if self.enabled and self.modifier_rank is not None and self.write_back is not None:
+        if not (self.enabled and self.modifier_rank is not None):
+            return False
+        if self.write_back is not None:
             self.write_back(self.gathered)
+        elif self.engine is not None:
+            self.engine.set_params(self.gathered)
         return False
 
 

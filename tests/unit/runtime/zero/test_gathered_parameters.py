@@ -1,0 +1,126 @@
+"""zero.GatheredParameters write-back + rejected dead flags.
+
+Reference: ``partition_parameters.py:1938`` (GatheredParameters re-partitions
+modified params transparently on exit), ``tests/unit/runtime/zero/test_zero_context*``.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+import deepspeed_tpu.parallel.mesh as mesh_mod
+from deepspeed_tpu.zero import GatheredParameters
+from tests.unit.simple_model import SimpleModel, random_dataloader
+
+HIDDEN = 32
+
+
+def _engine(zero_cfg=None, bf16=False):
+    mesh_mod.reset_topology()
+    config = {
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+        "zero_optimization": zero_cfg or {"stage": 3},
+    }
+    if bf16:
+        config["bf16"] = {"enabled": True}
+    engine, *_ = ds.initialize(model=SimpleModel(HIDDEN), config=config)
+    batch = next(random_dataloader(HIDDEN, total_samples=8, batch_size=8))
+    engine.init_params(batch)
+    return engine, batch
+
+
+@pytest.mark.parametrize("bf16", [False, True])
+def test_write_back_sticks_through_step(eight_devices, bf16):
+    engine, batch = _engine(bf16=bf16)
+    with GatheredParameters(engine=engine, modifier_rank=0) as params:
+        params["w0"][:] = 0.5  # user surgery on the gathered host view
+    # surgery must be visible in BOTH stores...
+    np.testing.assert_allclose(np.asarray(engine.get_params()["w0"]), 0.5, rtol=1e-2)
+    np.testing.assert_allclose(np.asarray(engine.get_master_params()["w0"]), 0.5)
+    # ...and survive an optimizer step (master was refreshed, not just params)
+    loss = engine(batch)
+    engine.backward(loss)
+    engine.step()
+    w0 = np.asarray(engine.get_params()["w0"], dtype=np.float32)
+    assert np.abs(w0 - 0.5).max() < 0.1, "step clobbered the surgery"
+
+
+def test_write_back_host_offload(eight_devices):
+    engine, batch = _engine(
+        zero_cfg={"stage": 2, "offload_optimizer": {"device": "cpu"}}
+    )
+    with GatheredParameters(engine=engine, modifier_rank=0) as params:
+        params["w0"][:] = 0.25
+    np.testing.assert_allclose(np.asarray(engine.get_master_params()["w0"]), 0.25)
+    loss = engine(batch)
+    engine.backward(loss)
+    engine.step()
+    w0 = np.asarray(engine.get_params()["w0"], dtype=np.float32)
+    assert np.abs(w0 - 0.25).max() < 0.1
+
+
+def test_write_back_param_stream(eight_devices):
+    from deepspeed_tpu.models.config import TransformerConfig
+    from deepspeed_tpu.models.transformer import TransformerLM
+    from deepspeed_tpu.ops.adam.cpu_adam_native import native_adam_available
+
+    if not native_adam_available():
+        pytest.skip("native cpu_adam unavailable")
+    mesh_mod.reset_topology()
+    model = TransformerLM(
+        TransformerConfig(
+            vocab_size=64,
+            hidden_size=16,
+            num_layers=2,
+            num_heads=2,
+            dtype="float32",
+            flash_attention=False,
+        )
+    )
+    engine, *_ = ds.initialize(
+        model=model,
+        config={
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+            "zero_optimization": {"stage": 3, "offload_param": {"device": "cpu"}},
+        },
+    )
+    toks = np.random.RandomState(0).randint(0, 64, size=(8, 8)).astype(np.int32)
+    engine.init_params({"input_ids": toks, "labels": toks})
+    with GatheredParameters(engine=engine, modifier_rank=0) as params:
+        params["final_norm_scale"][:] = 2.0
+        params["layers"]["wq"][:] = 0.125
+    np.testing.assert_allclose(np.asarray(engine.get_params()["final_norm_scale"]), 2.0)
+    np.testing.assert_allclose(
+        np.asarray(engine.get_master_params()["layers"]["wq"]), 0.125
+    )
+
+
+def test_partial_tree_without_write_back_raises(eight_devices):
+    engine, _ = _engine()
+    sub = {"w0": engine.get_params()["w0"]}  # partial tree
+    with pytest.raises(ValueError, match="write-back"):
+        GatheredParameters(sub, modifier_rank=0, engine=engine)
+
+
+def test_no_modifier_rank_reads_only(eight_devices):
+    engine, _ = _engine()
+    before = np.asarray(engine.get_params()["w0"]).copy()
+    with GatheredParameters(engine=engine) as params:
+        params["w0"][:] = 99.0  # read-only context: mutation is dropped
+    np.testing.assert_array_equal(np.asarray(engine.get_params()["w0"]), before)
+
+
+def test_sparse_gradients_rejected():
+    mesh_mod.reset_topology()
+    with pytest.raises(NotImplementedError, match="sparse_gradients"):
+        ds.initialize(
+            model=SimpleModel(HIDDEN),
+            config={
+                "train_micro_batch_size_per_gpu": 1,
+                "sparse_gradients": True,
+                "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+            },
+        )
