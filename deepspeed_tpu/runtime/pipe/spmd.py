@@ -217,11 +217,34 @@ class SpmdPipelineModule(DSModule):
         for i in range(lo.b0):
             x = layers[i].apply(params["prefix"][i], x, train=train)
 
+        # anchor the batch dim to the data axes on BOTH sides of the pipe
+        # region: without an explicit constraint XLA's propagation picks a
+        # different layout for the prefix output than the pipeline body wants
+        # and falls back to a full replicate-then-reshard of every microbatch
+        # handoff ("[SPMD] Involuntary full rematerialization")
+        batch_axes = self.topology.dense_batch_axes()
+        from jax.sharding import NamedSharding
+
+        def pin_batch(tree, batch_dim=0):
+            if batch_axes is None:
+                return tree
+
+            def leaf(l):
+                entries = [None] * l.ndim
+                entries[batch_dim] = batch_axes
+                return jax.lax.with_sharding_constraint(
+                    l, NamedSharding(mesh, P(*entries))
+                )
+
+            return jax.tree_util.tree_map(leaf, tree)
+
+        x = pin_batch(x)
         B = jax.tree_util.tree_leaves(x)[0].shape[0]
         if B % M != 0:
             raise ValueError(f"batch dim {B} not divisible by {M} microbatches")
         b = B // M
         mbs = jax.tree_util.tree_map(lambda l: l.reshape((M, b) + l.shape[1:]), x)
+        mbs = pin_batch(mbs, batch_dim=1)
 
         # XLA-CPU's AllReducePromotion pass crashes on sub-f32 collectives
         # generated by this region's transposes (cotangent psum / the emits
@@ -292,7 +315,9 @@ class SpmdPipelineModule(DSModule):
         outs = pipelined(params["body"], mbs)
         if promote:
             outs = jax.tree_util.tree_map(lambda o, d: o.astype(d), outs, act_dtypes)
+        outs = pin_batch(outs, batch_dim=1)
         x = jax.tree_util.tree_map(lambda o: o.reshape((B,) + o.shape[2:]), outs)
+        x = pin_batch(x)
 
         # suffix + loss on the full collected output (replicated over pipe)
         for i in range(lo.b1, lo.num_layers):

@@ -17,7 +17,10 @@ reference's prefetch coordinator, ``partitioned_param_coordinator.py``):
 * stage 3 — + bf16 compute params stored sharded; all-gathered at use.
 
 Per-param sharding picks the largest dimension divisible by the ZeRO world
-size, preferring dims untouched by tensor-parallel specs; small params below
+size — including a TP-sharded dim that can absorb the ZeRO axes on top
+(FSDP+TP stacking; on ties an unsharded dim wins). Stacking matters for
+gather tables: a vocab-parallel embedding keeps its hidden dim full so
+lookups don't produce H-sharded activations. Small params below
 ``param_persistence_threshold`` stay replicated (the reference's persistent
 params, parameter_offload.py:360).
 """
@@ -62,10 +65,12 @@ def shard_over_zero_axes(
 ) -> PartitionSpec:
     """Add ZeRO (data) sharding to ``base_spec`` (which may carry TP axes).
 
-    Chooses the largest dim that is (a) not already sharded, (b) divisible by
-    the ZeRO world size. Falls back to replicated if none qualifies or the
-    param is below ``threshold`` elements. ``axes`` overrides the topology's
-    default ZeRO axes (hpZ shards masters over more axes than params).
+    Chooses the largest dim divisible by the ZeRO world size — an unsharded
+    dim, or a TP-sharded dim whose size also absorbs the ZeRO axes stacked
+    on top (ties prefer the unsharded dim). Falls back to replicated if none
+    qualifies or the param is below ``threshold`` elements. ``axes``
+    overrides the topology's default ZeRO axes (hpZ shards masters over more
+    axes than params).
     """
     zero_axes = axes if axes is not None else topo.zero_shard_axes
     zero_size = int(np.prod([topo.axis_size(a) for a in zero_axes]))
@@ -78,15 +83,28 @@ def shard_over_zero_axes(
     if set(zero_axes) & _axes_in_use(entries):
         return PartitionSpec(*entries)
 
-    candidates = [
-        (dim_size, i)
-        for i, (dim_size, e) in enumerate(zip(shape, entries))
-        if e is None and dim_size % zero_size == 0
-    ]
+    # candidates: unsharded dims, OR TP-sharded dims that can absorb the
+    # ZeRO axes on top (vocab-parallel embeddings: stacking ZeRO onto the
+    # 'model' vocab dim keeps the hidden dim full, so lookups don't produce
+    # H-sharded activations that XLA must replicate-reshard). Prefer the
+    # largest dim; on ties, the unsharded one.
+    candidates = []
+    for i, (dim_size, e) in enumerate(zip(shape, entries)):
+        if e is None:
+            if dim_size % zero_size == 0:
+                candidates.append((dim_size, 1, i, None))
+        else:
+            existing = tuple(e) if isinstance(e, (tuple, list)) else (e,)
+            tp_size = int(np.prod([topo.axis_size(a) for a in existing]))
+            if dim_size % (tp_size * zero_size) == 0:
+                candidates.append((dim_size, 0, i, existing))
     if not candidates:
         return PartitionSpec(*entries)
-    _, best = max(candidates)
-    entries[best] = zero_axes if len(zero_axes) > 1 else zero_axes[0]
+    _, _, best, existing = max(candidates)
+    if existing is None:
+        entries[best] = zero_axes if len(zero_axes) > 1 else zero_axes[0]
+    else:
+        entries[best] = existing + tuple(zero_axes)
     return PartitionSpec(*entries)
 
 
