@@ -82,7 +82,11 @@ def _cached_attention(cfg, q, k_cache, v_cache, q_positions, kv_len_mask):
     if NKV != NH:
         k_cache = jnp.repeat(k_cache, NH // NKV, axis=2)
         v_cache = jnp.repeat(v_cache, NH // NKV, axis=2)
-    scale = 1.0 / np.sqrt(q.shape[-1])
+    scale = (
+        cfg.attn_softmax_scale
+        if getattr(cfg, "attn_softmax_scale", None) is not None
+        else 1.0 / np.sqrt(q.shape[-1])
+    )
     scores = jnp.einsum("btnd,bsnd->bnts", q, k_cache).astype(jnp.float32) * scale
     S = k_cache.shape[1]
     kv_pos = jnp.arange(S, dtype=jnp.int32)

@@ -45,6 +45,11 @@ class InferenceEngine:
         self._jit_forward = None
         self._rng = jax.random.PRNGKey(0)
         self._ds_config = None  # TransformerConfig when kernel-injected
+        # ZeRO-Inference (reference engine.py:1499-1520: stage-3 offload
+        # without an optimizer): params live in host DRAM / on NVMe and
+        # stream through HBM per layer — capacity over latency
+        self._param_stream = None
+        self._zero_config = self._parse_zero_inference()
 
         injected = False
         if self._config.replace_with_kernel_inject and _is_hf_model(model):
@@ -68,21 +73,62 @@ class InferenceEngine:
             ranks=[0],
         )
 
+    def _parse_zero_inference(self):
+        """DeepSpeedZeroConfig when the config asks for ZeRO-Inference
+        (stage 3 + offload_param), else None."""
+        zdict = self._config.zero or {}
+        if not zdict:
+            return None
+        from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+
+        zcfg = DeepSpeedZeroConfig(**zdict)
+        off = zcfg.offload_param
+        if int(zcfg.stage) >= 3 and off is not None and str(off.device) not in (
+            "none",
+            "OffloadDeviceEnum.none",
+        ):
+            return zcfg
+        return None
+
+    def _init_param_stream(self, params) -> None:
+        """ZeRO-Inference: install params into the layer-stream store
+        (host DRAM or NVMe) instead of HBM."""
+        from deepspeed_tpu.runtime.zero.param_offload import ParamStreamEngine
+
+        self._param_stream = ParamStreamEngine(
+            self.module,
+            params,
+            self.topology,
+            self._zero_config,
+            {},  # no optimizer: inference never steps (moments stay unallocated)
+            self.dtype,
+        )
+        self._params = None
+
     # --- weights --------------------------------------------------------
     def set_params(self, params: Any) -> None:
-        """Install a param pytree (cast to the inference dtype; TP-sharded
-        over the 'model' axis via AutoTP specs when tp_size > 1)."""
+        """Install a param pytree (cast to the inference dtype). Sharded
+        over the 'model' axis (AutoTP) when tp_size > 1 and over the
+        'expert' axis for MoE modules when ep_size > 1 — the reference's MP
+        + expert inference groups (``deepspeed/inference/engine.py:217,230``),
+        expressed as GSPMD placements instead of process groups."""
+        if self._zero_config is not None:
+            self._init_param_stream(params)
+            return
         cast = jax.tree_util.tree_map(
             lambda p: jnp.asarray(p).astype(self.dtype)
             if jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating)
             else jnp.asarray(p),
             params,
         )
-        if self.topology.get_model_parallel_world_size() > 1:
+        tp = self.topology.get_model_parallel_world_size() > 1
+        ep = self.topology.axis_size("expert") > 1
+        if tp or ep:
             from jax.sharding import NamedSharding, PartitionSpec
 
             tp_rules = None
             if hasattr(self.module, "tp_partition_rules"):
+                # model-family rules carry both 'model' and 'expert' axes
                 tp_rules = self.module.tp_partition_rules(cast)
             if tp_rules is None:
                 from deepspeed_tpu.module_inject.auto_tp import AutoTP
@@ -114,6 +160,14 @@ class InferenceEngine:
 
     # --- forward --------------------------------------------------------
     def forward(self, *inputs, **kwargs):
+        if self._zero_config is not None:
+            batch = inputs[0] if len(inputs) == 1 else (inputs if inputs else kwargs)
+            if self._param_stream is None:
+                self.init_params(batch)
+            from deepspeed_tpu.models.transformer import _split_batch
+
+            tokens, labels = _split_batch(batch)
+            return self._param_stream.eval_forward(jnp.asarray(tokens), labels)
         if self._params is None:
             batch = inputs[0] if inputs else kwargs
             self.init_params(batch)
@@ -143,6 +197,12 @@ class InferenceEngine:
         the paged KV-cache decode path replaces the full-seq forward later."""
         from deepspeed_tpu.inference.generation import greedy_generate
 
+        if self._zero_config is not None:
+            if self._param_stream is None:
+                self.init_params(jnp.asarray(input_ids))
+            return self._zero_generate(
+                input_ids, max_new_tokens, eos_token_id, pad_token_id
+            )
         if self._ds_config is not None and self._params is not None:
             # kernel-injected path: KV-cached prefill + per-token decode
             from deepspeed_tpu.inference.decode import generate as kv_generate
@@ -174,3 +234,32 @@ class InferenceEngine:
             pad_token_id=pad_token_id,
             jit_cache=self._gen_cache,
         )
+
+    def _zero_generate(self, input_ids, max_new_tokens, eos_token_id, pad_token_id):
+        """Greedy decode with layer-streamed params (ZeRO-Inference).
+
+        Every step re-runs the full fixed-shape forward (one compile) and
+        streams all layers through HBM — the reference's capacity-first
+        trade (15T params on one GPU at batch-latency cost,
+        docs/_posts/2022-09-10-zero-inference.md)."""
+        tokens = np.asarray(input_ids)
+        if tokens.ndim == 1:
+            tokens = tokens[None, :]
+        B, P = tokens.shape
+        L = P + max_new_tokens
+        padded = np.full((B, L), pad_token_id, dtype=tokens.dtype)
+        padded[:, :P] = tokens
+        finished = np.zeros(B, dtype=bool)
+        for cur in range(P, L):
+            logits = np.asarray(
+                self._param_stream.eval_forward(jnp.asarray(padded), None)
+            )
+            nxt = logits[:, cur - 1].argmax(-1).astype(padded.dtype)
+            if eos_token_id is not None:
+                nxt = np.where(finished, pad_token_id, nxt)
+            padded[:, cur] = nxt
+            if eos_token_id is not None:
+                finished |= nxt == eos_token_id
+                if finished.all():
+                    break
+        return padded

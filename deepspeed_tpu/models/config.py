@@ -24,11 +24,14 @@ class TransformerConfig:
     max_seq_len: int = 2048
 
     causal: bool = True  # False = bidirectional (encoder) attention
+    attn_softmax_scale: Optional[float] = None  # None = 1/sqrt(head_dim); GPT-Neo uses 1.0
+    prenorm: bool = True  # False = post-LN (BERT family): norm AFTER residual, no final norm
+    embed_norm: bool = False  # LayerNorm on the embedding output (BERT family)
     norm: str = "layernorm"  # layernorm | rmsnorm
     norm_eps: float = 1e-5
     position: str = "learned"  # learned | rope | alibi | none
     rope_theta: float = 10000.0
-    activation: str = "gelu"  # gelu | swiglu | relu | geglu
+    activation: str = "gelu"  # gelu | swiglu | relu | geglu | quick_gelu
     tie_embeddings: bool = True
     attn_dropout: float = 0.0
     hidden_dropout: float = 0.0

@@ -83,12 +83,19 @@ def _alibi_slopes(n_heads: int) -> np.ndarray:
 
 
 def cross_entropy_loss(logits, labels, ignore_index: int = -100):
-    """Mean token CE in fp32, ignoring ``ignore_index`` positions."""
+    """Mean token CE in fp32, ignoring ``ignore_index`` positions.
+
+    The gold logit comes from a one-hot select, not ``take_along_axis``: the
+    gather's transpose is a scatter-add whose sharding the SPMD partitioner
+    cannot reconcile with vocab-sharded logits (involuntary full
+    rematerialization); the select's transpose is a plain masked multiply."""
     logits = logits.astype(jnp.float32)
     mask = labels != ignore_index
     safe_labels = jnp.where(mask, labels, 0)
     logz = jax.nn.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, safe_labels[..., None], axis=-1)[..., 0]
+    vocab_iota = jnp.arange(logits.shape[-1], dtype=safe_labels.dtype)
+    onehot = safe_labels[..., None] == vocab_iota
+    gold = jnp.sum(jnp.where(onehot, logits, 0.0), axis=-1)
     nll = (logz - gold) * mask
     return nll.sum() / jnp.maximum(mask.sum(), 1)
 
@@ -126,6 +133,10 @@ class TransformerLM(DSModule):
         }
         if cfg.position == "learned":
             params["embed"]["pos"] = dense(next(k), (cfg.max_seq_len, H))
+        if cfg.embed_norm:
+            params["embed"]["norm_scale"] = jnp.ones((H,))
+            if cfg.norm == "layernorm":
+                params["embed"]["norm_bias"] = jnp.zeros((H,))
 
         layer: Dict[str, Any] = {
             "attn_norm_scale": jnp.ones((L, H)),
@@ -155,9 +166,10 @@ class TransformerLM(DSModule):
                 layer["b_in"] = jnp.zeros((L, I))
         params["layers"] = layer
 
-        params["final_norm_scale"] = jnp.ones((H,))
-        if cfg.norm == "layernorm":
-            params["final_norm_bias"] = jnp.zeros((H,))
+        if cfg.prenorm:  # post-LN nets end inside the last layer's norm
+            params["final_norm_scale"] = jnp.ones((H,))
+            if cfg.norm == "layernorm":
+                params["final_norm_bias"] = jnp.zeros((H,))
         if not cfg.tie_embeddings:
             params["lm_head"] = dense(next(k), (H, cfg.vocab_size))
         return params
@@ -205,7 +217,11 @@ class TransformerLM(DSModule):
         the NKV-head kv bytes.
         """
         cfg = self.config
-        scale = 1.0 / np.sqrt(q.shape[-1])
+        scale = (
+            cfg.attn_softmax_scale
+            if cfg.attn_softmax_scale is not None
+            else 1.0 / np.sqrt(q.shape[-1])
+        )
         if cfg.sequence_parallel:
             sp_out = self._sp_attention(q, k, v, positions, dropout_rng, train, scale)
             if sp_out is not None:
@@ -322,7 +338,13 @@ class TransformerLM(DSModule):
         B, T, H = x.shape
         NH, NKV, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
 
-        h = _norm(x, p["attn_norm_scale"], p.get("attn_norm_bias"), cfg.norm, cfg.norm_eps)
+        # pre-LN (GPT/Llama): norm feeds the block, residual stays unnormed.
+        # post-LN (BERT family): the block reads the residual stream raw and
+        # the norm is applied AFTER adding the residual.
+        if cfg.prenorm:
+            h = _norm(x, p["attn_norm_scale"], p.get("attn_norm_bias"), cfg.norm, cfg.norm_eps)
+        else:
+            h = x
         q = h @ p["wq"].astype(h.dtype)
         k = h @ p["wk"].astype(h.dtype)
         v = h @ p["wv"].astype(h.dtype)
@@ -342,11 +364,53 @@ class TransformerLM(DSModule):
         if train and cfg.hidden_dropout > 0 and r_hid is not None:
             keep = jax.random.bernoulli(r_hid, 1 - cfg.hidden_dropout, attn.shape)
             attn = attn * keep / (1 - cfg.hidden_dropout)
-        x = x + attn
-
-        h = _norm(x, p["mlp_norm_scale"], p.get("mlp_norm_bias"), cfg.norm, cfg.norm_eps)
+        if cfg.prenorm:
+            x = x + attn
+            h = _norm(x, p["mlp_norm_scale"], p.get("mlp_norm_bias"), cfg.norm, cfg.norm_eps)
+        else:
+            x = _norm(x + attn, p["attn_norm_scale"], p.get("attn_norm_bias"), cfg.norm, cfg.norm_eps)
+            h = x
         out, aux = self._mlp(p, h, r_mlp, train)
-        return x + out, aux
+        if cfg.prenorm:
+            return x + out, aux
+        return _norm(x + out, p["mlp_norm_scale"], p.get("mlp_norm_bias"), cfg.norm, cfg.norm_eps), aux
+
+    def _activation_constraint(self, x):
+        """Pin [B, T, H] activations to (batch-axes, sequence, None): one
+        explicit anchor stops XLA's sharding propagation from flip-flopping
+        layouts at the embed→scan and scan→head boundaries ("involuntary
+        full rematerialization" replicate-then-reshard). H stays replicated
+        over 'model' — Megatron semantics: activations are full between
+        blocks, sharded only inside them."""
+        try:
+            from deepspeed_tpu.parallel.mesh import get_topology
+
+            topo = get_topology()
+        except Exception:
+            return x
+        from jax.sharding import NamedSharding
+
+        batch_axes = topo.dense_batch_axes()
+        # pin T over 'sequence' only for SP models: a non-SP model's attention
+        # needs the full sequence, and a T pin would force a replicate-reshard
+        # around every attention block
+        seq = (
+            "sequence"
+            if self.config.sequence_parallel and topo.axis_size("sequence") > 1
+            else None
+        )
+        if batch_axes is None and seq is None:
+            return x
+        # standalone model.apply (no engine placed the batch): skip when the
+        # shapes don't tile the mesh rather than demand engine batch sizes
+        axes = batch_axes if isinstance(batch_axes, tuple) else (batch_axes,) if batch_axes else ()
+        b_tile = int(np.prod([topo.axis_size(a) for a in axes])) if axes else 1
+        s_tile = topo.axis_size("sequence") if seq else 1
+        if x.shape[0] % b_tile or x.shape[1] % s_tile:
+            return x
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(topo.mesh, P(batch_axes, seq, None))
+        )
 
     def _forward(self, params, tokens, rngs, train):
         cfg = self.config
@@ -356,6 +420,15 @@ class TransformerLM(DSModule):
         positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
         if cfg.position == "learned":
             x = x + params["embed"]["pos"].astype(self.dtype)[positions[0]][None]
+        if cfg.embed_norm:
+            x = _norm(
+                x,
+                params["embed"]["norm_scale"],
+                params["embed"].get("norm_bias"),
+                cfg.norm,
+                cfg.norm_eps,
+            )
+        x = self._activation_constraint(x)
 
         base_rng = (rngs or {}).get("dropout") if isinstance(rngs, dict) else rngs
         L = cfg.num_layers
@@ -367,7 +440,7 @@ class TransformerLM(DSModule):
             else:
                 sub = None
             x, aux = self._layer(x, per_layer, positions, sub, train)
-            return (x, rng), aux
+            return (self._activation_constraint(x), rng), aux
 
         if cfg.remat:
             policy = getattr(jax.checkpoint_policies, cfg.remat_policy, None)
@@ -382,7 +455,8 @@ class TransformerLM(DSModule):
                 (x, base_rng), aux = body((x, base_rng), self._layer_params(params, i))
                 aux_total = aux_total + aux
 
-        x = _norm(x, params["final_norm_scale"], params.get("final_norm_bias"), cfg.norm, cfg.norm_eps)
+        if cfg.prenorm:
+            x = _norm(x, params["final_norm_scale"], params.get("final_norm_bias"), cfg.norm, cfg.norm_eps)
         if cfg.tie_embeddings:
             logits = x @ params["embed"]["tokens"].astype(self.dtype).T
         else:
@@ -413,6 +487,14 @@ class TransformerLM(DSModule):
                 x = x + resident["embed"]["pos"].astype(self.dtype)[
                     jnp.arange(T, dtype=jnp.int32)
                 ][None]
+            if cfg.embed_norm:
+                x = _norm(
+                    x,
+                    resident["embed"]["norm_scale"],
+                    resident["embed"].get("norm_bias"),
+                    cfg.norm,
+                    cfg.norm_eps,
+                )
             return x
 
         def layer_fwd(layer_params, h, positions, rng, train=True):
@@ -420,13 +502,15 @@ class TransformerLM(DSModule):
             return out
 
         def head_loss(resident, h, labels):
-            x = _norm(
-                h,
-                resident["final_norm_scale"],
-                resident.get("final_norm_bias"),
-                cfg.norm,
-                cfg.norm_eps,
-            )
+            x = h
+            if cfg.prenorm:
+                x = _norm(
+                    x,
+                    resident["final_norm_scale"],
+                    resident.get("final_norm_bias"),
+                    cfg.norm,
+                    cfg.norm_eps,
+                )
             if cfg.tie_embeddings:
                 logits = x @ resident["embed"]["tokens"].astype(self.dtype).T
             else:

@@ -386,7 +386,412 @@ class GPTJPolicy(DSPolicy):
         }
 
 
-# registry (reference replace_policy.py replace_policies)
+class BertPolicy(DSPolicy):
+    """bert (reference containers/bert.py): post-LN encoder, bidirectional
+    attention, learned positions + embedding LayerNorm, gelu. The
+    single-segment token_type row 0 is folded into the position table, so
+    inference without segment ids matches HF exactly."""
+
+    model_types = ["bert"]
+
+    def build_config(self, c) -> TransformerConfig:
+        return TransformerConfig(
+            vocab_size=c.vocab_size,
+            hidden_size=c.hidden_size,
+            intermediate_size=c.intermediate_size,
+            num_layers=c.num_hidden_layers,
+            num_heads=c.num_attention_heads,
+            max_seq_len=c.max_position_embeddings,
+            causal=False,
+            prenorm=False,
+            embed_norm=True,
+            norm="layernorm",
+            norm_eps=getattr(c, "layer_norm_eps", 1e-12),
+            position="learned",
+            activation="gelu",
+            use_bias=True,
+            tie_embeddings=True,
+        )
+
+    _prefix = "bert."
+
+    def convert_weights(self, sd, cfg) -> Dict[str, Any]:
+        L = cfg.num_layers
+        pre = self._prefix if any(k.startswith(self._prefix) for k in sd) else ""
+        emb = f"{pre}embeddings."
+        enc = f"{pre}encoder.layer."
+
+        def lw(i, name):
+            return _t(sd[f"{enc}{i}.{name}.weight"])
+
+        def lb(i, name):
+            return np.asarray(sd[f"{enc}{i}.{name}.bias"])
+
+        layer = {
+            # post-LN: attn_norm follows attention+residual, mlp_norm the FFN
+            "attn_norm_scale": _stack([sd[f"{enc}{i}.attention.output.LayerNorm.weight"] for i in range(L)]),
+            "attn_norm_bias": _stack([sd[f"{enc}{i}.attention.output.LayerNorm.bias"] for i in range(L)]),
+            "wq": _stack([lw(i, "attention.self.query") for i in range(L)]),
+            "wk": _stack([lw(i, "attention.self.key") for i in range(L)]),
+            "wv": _stack([lw(i, "attention.self.value") for i in range(L)]),
+            "bq": _stack([lb(i, "attention.self.query") for i in range(L)]),
+            "bk": _stack([lb(i, "attention.self.key") for i in range(L)]),
+            "bv": _stack([lb(i, "attention.self.value") for i in range(L)]),
+            "wo": _stack([lw(i, "attention.output.dense") for i in range(L)]),
+            "bo": _stack([lb(i, "attention.output.dense") for i in range(L)]),
+            "mlp_norm_scale": _stack([sd[f"{enc}{i}.output.LayerNorm.weight"] for i in range(L)]),
+            "mlp_norm_bias": _stack([sd[f"{enc}{i}.output.LayerNorm.bias"] for i in range(L)]),
+            "w_in": _stack([lw(i, "intermediate.dense") for i in range(L)]),
+            "b_in": _stack([lb(i, "intermediate.dense") for i in range(L)]),
+            "w_out": _stack([lw(i, "output.dense") for i in range(L)]),
+            "b_out": _stack([lb(i, "output.dense") for i in range(L)]),
+        }
+        pos = np.asarray(sd[f"{emb}position_embeddings.weight"])
+        tt_key = f"{emb}token_type_embeddings.weight"
+        if tt_key in sd:
+            pos = pos + np.asarray(sd[tt_key])[0][None, :]
+        return {
+            "embed": {
+                "tokens": np.asarray(sd[f"{emb}word_embeddings.weight"]),
+                "pos": pos,
+                "norm_scale": np.asarray(sd[f"{emb}LayerNorm.weight"]),
+                "norm_bias": np.asarray(sd[f"{emb}LayerNorm.bias"]),
+            },
+            "layers": layer,
+        }
+
+
+class DistilBertPolicy(DSPolicy):
+    """distil_bert (reference containers/distil_bert.py): BERT family without
+    token types; HF distilbert names."""
+
+    model_types = ["distilbert", "distil_bert"]
+
+    def build_config(self, c) -> TransformerConfig:
+        return TransformerConfig(
+            vocab_size=c.vocab_size,
+            hidden_size=c.dim,
+            intermediate_size=c.hidden_dim,
+            num_layers=c.n_layers,
+            num_heads=c.n_heads,
+            max_seq_len=c.max_position_embeddings,
+            causal=False,
+            prenorm=False,
+            embed_norm=True,
+            norm="layernorm",
+            norm_eps=1e-12,
+            position="learned",
+            activation="gelu",
+            use_bias=True,
+            tie_embeddings=True,
+        )
+
+    def convert_weights(self, sd, cfg) -> Dict[str, Any]:
+        L = cfg.num_layers
+        pre = "distilbert." if any(k.startswith("distilbert.") for k in sd) else ""
+        emb = f"{pre}embeddings."
+        enc = f"{pre}transformer.layer."
+
+        def lw(i, name):
+            return _t(sd[f"{enc}{i}.{name}.weight"])
+
+        def lb(i, name):
+            return np.asarray(sd[f"{enc}{i}.{name}.bias"])
+
+        layer = {
+            "attn_norm_scale": _stack([sd[f"{enc}{i}.sa_layer_norm.weight"] for i in range(L)]),
+            "attn_norm_bias": _stack([sd[f"{enc}{i}.sa_layer_norm.bias"] for i in range(L)]),
+            "wq": _stack([lw(i, "attention.q_lin") for i in range(L)]),
+            "wk": _stack([lw(i, "attention.k_lin") for i in range(L)]),
+            "wv": _stack([lw(i, "attention.v_lin") for i in range(L)]),
+            "bq": _stack([lb(i, "attention.q_lin") for i in range(L)]),
+            "bk": _stack([lb(i, "attention.k_lin") for i in range(L)]),
+            "bv": _stack([lb(i, "attention.v_lin") for i in range(L)]),
+            "wo": _stack([lw(i, "attention.out_lin") for i in range(L)]),
+            "bo": _stack([lb(i, "attention.out_lin") for i in range(L)]),
+            "mlp_norm_scale": _stack([sd[f"{enc}{i}.output_layer_norm.weight"] for i in range(L)]),
+            "mlp_norm_bias": _stack([sd[f"{enc}{i}.output_layer_norm.bias"] for i in range(L)]),
+            "w_in": _stack([lw(i, "ffn.lin1") for i in range(L)]),
+            "b_in": _stack([lb(i, "ffn.lin1") for i in range(L)]),
+            "w_out": _stack([lw(i, "ffn.lin2") for i in range(L)]),
+            "b_out": _stack([lb(i, "ffn.lin2") for i in range(L)]),
+        }
+        return {
+            "embed": {
+                "tokens": np.asarray(sd[f"{emb}word_embeddings.weight"]),
+                "pos": np.asarray(sd[f"{emb}position_embeddings.weight"]),
+                "norm_scale": np.asarray(sd[f"{emb}LayerNorm.weight"]),
+                "norm_bias": np.asarray(sd[f"{emb}LayerNorm.bias"]),
+            },
+            "layers": layer,
+        }
+
+
+class GPTNeoPolicy(DSPolicy):
+    """gpt_neo (reference containers/gptneo.py): learned positions, gelu,
+    qkv without biases. HF alternates global/local (windowed) attention
+    blocks; this port computes full causal attention for both — identical
+    whenever the sequence fits the local window (256 for the released
+    checkpoints)."""
+
+    model_types = ["gpt_neo", "gptneo"]
+
+    def build_config(self, c) -> TransformerConfig:
+        return TransformerConfig(
+            vocab_size=c.vocab_size,
+            hidden_size=c.hidden_size,
+            intermediate_size=getattr(c, "intermediate_size", None) or 4 * c.hidden_size,
+            num_layers=c.num_layers,
+            num_heads=c.num_heads,
+            max_seq_len=c.max_position_embeddings,
+            norm="layernorm",
+            position="learned",
+            activation="gelu",
+            use_bias=True,
+            qkv_bias=False,
+            attn_softmax_scale=1.0,  # GPT-Neo's unscaled attention scores
+            tie_embeddings=True,
+        )
+
+    def convert_weights(self, sd, cfg) -> Dict[str, Any]:
+        L = cfg.num_layers
+        pre = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+
+        def lw(i, name):
+            return _t(sd[f"{pre}h.{i}.{name}.weight"])
+
+        def lb(i, name):
+            return np.asarray(sd[f"{pre}h.{i}.{name}.bias"])
+
+        layer = {
+            "attn_norm_scale": _stack([sd[f"{pre}h.{i}.ln_1.weight"] for i in range(L)]),
+            "attn_norm_bias": _stack([sd[f"{pre}h.{i}.ln_1.bias"] for i in range(L)]),
+            "wq": _stack([lw(i, "attn.attention.q_proj") for i in range(L)]),
+            "wk": _stack([lw(i, "attn.attention.k_proj") for i in range(L)]),
+            "wv": _stack([lw(i, "attn.attention.v_proj") for i in range(L)]),
+            "wo": _stack([lw(i, "attn.attention.out_proj") for i in range(L)]),
+            "bo": _stack([lb(i, "attn.attention.out_proj") for i in range(L)]),
+            "mlp_norm_scale": _stack([sd[f"{pre}h.{i}.ln_2.weight"] for i in range(L)]),
+            "mlp_norm_bias": _stack([sd[f"{pre}h.{i}.ln_2.bias"] for i in range(L)]),
+            "w_in": _stack([lw(i, "mlp.c_fc") for i in range(L)]),
+            "b_in": _stack([lb(i, "mlp.c_fc") for i in range(L)]),
+            "w_out": _stack([lw(i, "mlp.c_proj") for i in range(L)]),
+            "b_out": _stack([lb(i, "mlp.c_proj") for i in range(L)]),
+        }
+        return {
+            "embed": {
+                "tokens": np.asarray(sd[f"{pre}wte.weight"]),
+                "pos": np.asarray(sd[f"{pre}wpe.weight"]),
+            },
+            "layers": layer,
+            "final_norm_scale": np.asarray(sd[f"{pre}ln_f.weight"]),
+            "final_norm_bias": np.asarray(sd[f"{pre}ln_f.bias"]),
+        }
+
+
+class MegatronGPTPolicy(DSPolicy):
+    """megatron_gpt (reference containers/megatron_gpt.py): Megatron-LM GPT
+    layout — fused per-head-interleaved qkv (same [NH, 3, D] packing as
+    NeoX, its descendant), learned positions, gelu."""
+
+    model_types = ["megatron-gpt", "megatron_gpt", "megatron"]
+
+    def build_config(self, c) -> TransformerConfig:
+        return TransformerConfig(
+            vocab_size=c.vocab_size,
+            hidden_size=c.hidden_size,
+            intermediate_size=getattr(c, "ffn_hidden_size", None) or 4 * c.hidden_size,
+            num_layers=getattr(c, "num_layers", None) or c.num_hidden_layers,
+            num_heads=getattr(c, "num_attention_heads", None),
+            max_seq_len=getattr(c, "max_position_embeddings", 2048),
+            norm="layernorm",
+            position="learned",
+            activation="gelu",
+            use_bias=True,
+            tie_embeddings=True,
+        )
+
+    def convert_weights(self, sd, cfg) -> Dict[str, Any]:
+        L, H = cfg.num_layers, cfg.hidden_size
+        NH, D = cfg.num_heads, cfg.head_dim
+        lyr = "language_model.transformer.layers."
+        emb = "language_model.embedding."
+        wqs, wks, wvs, bqs, bks, bvs = [], [], [], [], [], []
+        for i in range(L):
+            w = np.asarray(sd[f"{lyr}{i}.attention.query_key_value.weight"])
+            b = np.asarray(sd[f"{lyr}{i}.attention.query_key_value.bias"])
+            w = w.reshape(NH, 3, D, H)
+            b = b.reshape(NH, 3, D)
+            wqs.append(np.ascontiguousarray(w[:, 0].reshape(NH * D, H).T))
+            wks.append(np.ascontiguousarray(w[:, 1].reshape(NH * D, H).T))
+            wvs.append(np.ascontiguousarray(w[:, 2].reshape(NH * D, H).T))
+            bqs.append(b[:, 0].reshape(-1))
+            bks.append(b[:, 1].reshape(-1))
+            bvs.append(b[:, 2].reshape(-1))
+        layer = {
+            "attn_norm_scale": _stack([sd[f"{lyr}{i}.input_layernorm.weight"] for i in range(L)]),
+            "attn_norm_bias": _stack([sd[f"{lyr}{i}.input_layernorm.bias"] for i in range(L)]),
+            "wq": _stack(wqs), "wk": _stack(wks), "wv": _stack(wvs),
+            "bq": _stack(bqs), "bk": _stack(bks), "bv": _stack(bvs),
+            "wo": _stack([_t(sd[f"{lyr}{i}.attention.dense.weight"]) for i in range(L)]),
+            "bo": _stack([sd[f"{lyr}{i}.attention.dense.bias"] for i in range(L)]),
+            "mlp_norm_scale": _stack([sd[f"{lyr}{i}.post_attention_layernorm.weight"] for i in range(L)]),
+            "mlp_norm_bias": _stack([sd[f"{lyr}{i}.post_attention_layernorm.bias"] for i in range(L)]),
+        }
+        if f"{lyr}0.mlp.dense_h_to_4h.weight" in sd:  # dense MLP (MoE subclass: experts)
+            layer.update(
+                w_in=_stack([_t(sd[f"{lyr}{i}.mlp.dense_h_to_4h.weight"]) for i in range(L)]),
+                b_in=_stack([sd[f"{lyr}{i}.mlp.dense_h_to_4h.bias"] for i in range(L)]),
+                w_out=_stack([_t(sd[f"{lyr}{i}.mlp.dense_4h_to_h.weight"]) for i in range(L)]),
+                b_out=_stack([sd[f"{lyr}{i}.mlp.dense_4h_to_h.bias"] for i in range(L)]),
+            )
+        return {
+            "embed": {
+                "tokens": np.asarray(sd[f"{emb}word_embeddings.weight"]),
+                "pos": np.asarray(sd[f"{emb}position_embeddings.weight"]),
+            },
+            "layers": layer,
+            "final_norm_scale": np.asarray(sd["language_model.transformer.final_layernorm.weight"]),
+            "final_norm_bias": np.asarray(sd["language_model.transformer.final_layernorm.bias"]),
+        }
+
+
+class MegatronGPTMoEPolicy(MegatronGPTPolicy):
+    """megatron_gpt_moe (reference containers/megatron_gpt_moe.py): Megatron
+    GPT whose MLPs are DeepSpeed-MoE expert banks
+    (``mlp.deepspeed_moe.experts.deepspeed_experts.{e}.*`` + the gate).
+    Converts onto ``MoETransformerLM`` (every layer MoE, top-k gate)."""
+
+    model_types = ["megatron-gpt-moe", "megatron_gpt_moe"]
+
+    def build_moe_config(self, c):
+        from deepspeed_tpu.models.moe_transformer import MoETransformerConfig
+
+        base = self.build_config(c)
+        import dataclasses
+
+        fields = {f.name: getattr(base, f.name) for f in dataclasses.fields(base)}
+        fields.update(
+            num_experts=getattr(c, "num_experts", 1),
+            moe_top_k=getattr(c, "moe_top_k", 1),
+            moe_layer_freq=1,
+        )
+        return MoETransformerConfig(**fields)
+
+    def convert_weights(self, sd, cfg) -> Dict[str, Any]:
+        params = super().convert_weights(sd, cfg)  # attn/norm/embed fields
+        L = cfg.num_layers
+        E = cfg.num_experts
+        lyr = "language_model.transformer.layers."
+        layer = params["layers"]
+        exp = "mlp.deepspeed_moe.experts.deepspeed_experts."
+        moe = {
+            "gate": {
+                "wg": _stack(
+                    [_t(sd[f"{lyr}{i}.mlp.deepspeed_moe.gate.wg.weight"]) for i in range(L)]
+                )
+            },
+            "experts": {
+                "w_in": _stack(
+                    [
+                        np.stack([_t(sd[f"{lyr}{i}.{exp}{e}.dense_h_to_4h.weight"]) for e in range(E)])
+                        for i in range(L)
+                    ]
+                ),
+                "b_in": _stack(
+                    [
+                        np.stack([np.asarray(sd[f"{lyr}{i}.{exp}{e}.dense_h_to_4h.bias"]) for e in range(E)])
+                        for i in range(L)
+                    ]
+                ),
+                "w_out": _stack(
+                    [
+                        np.stack([_t(sd[f"{lyr}{i}.{exp}{e}.dense_4h_to_h.weight"]) for e in range(E)])
+                        for i in range(L)
+                    ]
+                ),
+                "b_out": _stack(
+                    [
+                        np.stack([np.asarray(sd[f"{lyr}{i}.{exp}{e}.dense_4h_to_h.bias"]) for e in range(E)])
+                        for i in range(L)
+                    ]
+                ),
+            },
+        }
+        layer["moe"] = moe
+        return params
+
+
+class CLIPTextPolicy(DSPolicy):
+    """clip (reference containers/clip.py): the CLIP *text* tower — pre-LN
+    causal encoder with quick_gelu and learned positions. (The vision tower
+    and the diffusers unet/vae containers are convolutional and outside the
+    decoder family this framework fuses — reference parity for those is via
+    plain XLA compilation of the user's model, not injection.)"""
+
+    model_types = ["clip", "clip_text_model", "clip-text"]
+
+    def build_config(self, c) -> TransformerConfig:
+        c = getattr(c, "text_config", c)
+        return TransformerConfig(
+            vocab_size=c.vocab_size,
+            hidden_size=c.hidden_size,
+            intermediate_size=c.intermediate_size,
+            num_layers=c.num_hidden_layers,
+            num_heads=c.num_attention_heads,
+            max_seq_len=c.max_position_embeddings,
+            causal=True,  # CLIP text uses a causal mask
+            norm="layernorm",
+            norm_eps=getattr(c, "layer_norm_eps", 1e-5),
+            position="learned",
+            activation="quick_gelu" if getattr(c, "hidden_act", "quick_gelu") == "quick_gelu" else "gelu",
+            use_bias=True,
+            tie_embeddings=True,
+        )
+
+    def convert_weights(self, sd, cfg) -> Dict[str, Any]:
+        L = cfg.num_layers
+        pre = "text_model." if any(k.startswith("text_model.") for k in sd) else ""
+        enc = f"{pre}encoder.layers."
+
+        def lw(i, name):
+            return _t(sd[f"{enc}{i}.{name}.weight"])
+
+        def lb(i, name):
+            return np.asarray(sd[f"{enc}{i}.{name}.bias"])
+
+        layer = {
+            "attn_norm_scale": _stack([sd[f"{enc}{i}.layer_norm1.weight"] for i in range(L)]),
+            "attn_norm_bias": _stack([sd[f"{enc}{i}.layer_norm1.bias"] for i in range(L)]),
+            "wq": _stack([lw(i, "self_attn.q_proj") for i in range(L)]),
+            "wk": _stack([lw(i, "self_attn.k_proj") for i in range(L)]),
+            "wv": _stack([lw(i, "self_attn.v_proj") for i in range(L)]),
+            "bq": _stack([lb(i, "self_attn.q_proj") for i in range(L)]),
+            "bk": _stack([lb(i, "self_attn.k_proj") for i in range(L)]),
+            "bv": _stack([lb(i, "self_attn.v_proj") for i in range(L)]),
+            "wo": _stack([lw(i, "self_attn.out_proj") for i in range(L)]),
+            "bo": _stack([lb(i, "self_attn.out_proj") for i in range(L)]),
+            "mlp_norm_scale": _stack([sd[f"{enc}{i}.layer_norm2.weight"] for i in range(L)]),
+            "mlp_norm_bias": _stack([sd[f"{enc}{i}.layer_norm2.bias"] for i in range(L)]),
+            "w_in": _stack([lw(i, "mlp.fc1") for i in range(L)]),
+            "b_in": _stack([lb(i, "mlp.fc1") for i in range(L)]),
+            "w_out": _stack([lw(i, "mlp.fc2") for i in range(L)]),
+            "b_out": _stack([lb(i, "mlp.fc2") for i in range(L)]),
+        }
+        return {
+            "embed": {
+                "tokens": np.asarray(sd[f"{pre}embeddings.token_embedding.weight"]),
+                "pos": np.asarray(sd[f"{pre}embeddings.position_embedding.weight"]),
+            },
+            "layers": layer,
+            "final_norm_scale": np.asarray(sd[f"{pre}final_layer_norm.weight"]),
+            "final_norm_bias": np.asarray(sd[f"{pre}final_layer_norm.bias"]),
+        }
+
+
+# registry (reference replace_policy.py replace_policies). unet/vae are
+# convolutional diffusers containers with no decoder analog — on TPU those
+# models run through plain XLA compilation, not injection.
 replace_policies: List[type] = [
     GPT2Policy,
     LlamaPolicy,
@@ -394,6 +799,12 @@ replace_policies: List[type] = [
     GPTNeoXPolicy,
     BloomPolicy,
     GPTJPolicy,
+    BertPolicy,
+    DistilBertPolicy,
+    GPTNeoPolicy,
+    MegatronGPTPolicy,
+    MegatronGPTMoEPolicy,
+    CLIPTextPolicy,
 ]
 
 

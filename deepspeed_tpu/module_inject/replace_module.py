@@ -62,10 +62,17 @@ def replace_transformer_layer(
         raise ValueError("replace_transformer_layer needs model or model_config")
     model_type = getattr(hf_config, "model_type", None) or type(hf_config).__name__
     policy = policy_for(model_type)
-    ds_config = policy.build_config(hf_config)
+    if hasattr(policy, "build_moe_config"):
+        from deepspeed_tpu.models.moe_transformer import MoETransformerLM
+
+        ds_config = policy.build_moe_config(hf_config)
+        model_cls = MoETransformerLM
+    else:
+        ds_config = policy.build_config(hf_config)
+        model_cls = TransformerLM
     if dtype is not None:
         ds_config.dtype = dtype
-    ds_model = TransformerLM(ds_config)
+    ds_model = model_cls(ds_config)
     log_dist(
         f"module_inject: {model_type} → TransformerLM "
         f"(L={ds_config.num_layers}, H={ds_config.hidden_size}, "

@@ -45,6 +45,16 @@ def init_dense_ffn(
     return params
 
 
+def _pointwise_activation(x: jnp.ndarray, activation: str) -> jnp.ndarray:
+    if activation == "gelu":
+        return jax.nn.gelu(x)
+    if activation == "relu":
+        return jax.nn.relu(x)
+    if activation == "quick_gelu":  # CLIP: x * sigmoid(1.702 x)
+        return x * jax.nn.sigmoid(1.702 * x)
+    raise ValueError(f"unknown pointwise activation {activation!r}")
+
+
 def apply_dense_ffn(params: Dict[str, Any], x: jnp.ndarray, activation: str = "gelu") -> jnp.ndarray:
     """[..., H] → [..., H] dense FFN; single source of activation semantics
     (shared by TransformerLM layers and the PR-MoE residual branch)."""
@@ -58,7 +68,7 @@ def apply_dense_ffn(params: Dict[str, Any], x: jnp.ndarray, activation: str = "g
         inner = x @ params["w_in"].astype(dt)
         if "b_in" in params:
             inner = inner + params["b_in"].astype(dt)
-        inner = jax.nn.gelu(inner) if activation == "gelu" else jax.nn.relu(inner)
+        inner = _pointwise_activation(inner, activation)
     out = inner @ params["w_out"].astype(dt)
     if "b_out" in params:
         out = out + params["b_out"].astype(dt)
@@ -105,7 +115,7 @@ def apply_expert_ffn(params: Dict[str, Any], x: jnp.ndarray, activation: str = "
         inner = jnp.einsum("ech,ehi->eci", x, params["w_in"].astype(dt))
         if "b_in" in params:
             inner = inner + params["b_in"][:, None, :].astype(dt)
-        inner = jax.nn.gelu(inner) if activation == "gelu" else jax.nn.relu(inner)
+        inner = _pointwise_activation(inner, activation)
     out = jnp.einsum("eci,eih->ech", inner, params["w_out"].astype(dt))
     if "b_out" in params:
         out = out + params["b_out"][:, None, :].astype(dt)
