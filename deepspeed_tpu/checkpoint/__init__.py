@@ -6,6 +6,11 @@ from deepspeed_tpu.checkpoint.deepspeed_checkpoint import (
     load_hp_checkpoint_state,
     universal_param_names,
 )
+from deepspeed_tpu.checkpoint.reference_ingest import (
+    ingest_reference_checkpoint,
+    merge_reference_model_states,
+    merge_reference_zero_fp32,
+)
 from deepspeed_tpu.checkpoint.reshape_utils import (
     ReshapeMeg2D,
     merge_tp_slices,

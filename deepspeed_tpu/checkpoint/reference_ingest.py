@@ -1,0 +1,218 @@
+"""Ingest reference-layout (DeepSpeed torch) checkpoints.
+
+The reference saves per-rank torch pickles (``deepspeed/runtime/engine.py:2582-2588``):
+
+* ``{tag}/mp_rank_{mp:02d}_model_states.pt`` — module weights, one file per
+  model-parallel rank (TP-sharded tensors), replicated across dp;
+* ``{tag}/zero_pp_rank_{dp}_mp_rank_{mp:02d}_optim_states.pt`` — the ZeRO
+  stage-1/2 optimizer partitions: each dp rank's slice of the flat fp32
+  master per param group (``single_partition_of_fp32_groups``,
+  stage_1_and_2.py:2035), with per-param shapes recorded in the model file
+  (``param_shapes``).
+
+This module rebuilds full tensors from that layout — TP shards merged along
+per-name axes supplied by the architecture's injection policy, dp-flat fp32
+partitions concatenated and re-split by the recorded shapes (the
+``ds_to_universal.py`` algorithm) — and converts the merged state dict onto
+the fused TPU model via the same policy used for HF injection. Loading into
+a *different* mesh needs nothing further: params are global arrays and the
+GSPMD partitioner reshards on placement.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.checkpoint.reshape_utils import merge_tp_slices
+from deepspeed_tpu.module_inject.containers import policy_for
+from deepspeed_tpu.utils.logging import log_dist
+
+# torch [out, in] Linear convention: "column"-parallel shards the OUT dim
+# (axis 0), "row"-parallel the IN dim (axis 1)
+_MEGATRON_TP_AXES = [
+    (r"query_key_value\.(weight|bias)$", 0),
+    (r"dense_h_to_4h\.(weight|bias)$", 0),
+    (r"attention\.dense\.weight$", 1),
+    (r"dense_4h_to_h\.weight$", 1),
+    (r"word_embeddings\.weight$", 0),
+]
+
+_HF_LLAMA_TP_AXES = [
+    (r"(q|k|v)_proj\.weight$", 0),
+    (r"(gate|up)_proj\.weight$", 0),
+    (r"(o|down)_proj\.weight$", 1),
+    (r"embed_tokens\.weight$", 0),
+    (r"lm_head\.weight$", 0),
+]
+
+
+def tp_merge_axis(name: str, model_type: str) -> Optional[int]:
+    """Concat axis for one param's TP shards; None = replicated (take rank 0).
+    The reference records no sharding metadata in the files — the axis is a
+    property of the architecture (module_inject policy knowledge)."""
+    table = (
+        _HF_LLAMA_TP_AXES
+        if model_type in ("llama", "mistral")
+        else _MEGATRON_TP_AXES
+    )
+    for pattern, axis in table:
+        if re.search(pattern, name):
+            return axis
+    return None
+
+
+def _torch_load(path: str):
+    import torch
+
+    return torch.load(path, map_location="cpu", weights_only=False)
+
+
+def _to_numpy(t) -> np.ndarray:
+    if hasattr(t, "detach"):
+        return t.detach().cpu().float().numpy()
+    return np.asarray(t)
+
+
+def _resolve_tag_dir(ckpt_dir: str, tag: Optional[str]) -> str:
+    if tag is None:
+        latest = os.path.join(ckpt_dir, "latest")
+        if os.path.isfile(latest):
+            with open(latest) as f:
+                tag = f.read().strip()
+    path = os.path.join(ckpt_dir, tag) if tag else ckpt_dir
+    if not os.path.isdir(path):
+        raise FileNotFoundError(f"no checkpoint dir at {path}")
+    return path
+
+
+def _model_state_files(path: str) -> List[str]:
+    if glob.glob(os.path.join(path, "layer_*-model_*-model_states.pt")):
+        raise NotImplementedError(
+            "pipeline-partitioned reference checkpoints (per-layer "
+            "layer_XX-model_YY files) are not ingestable yet; consolidate "
+            "with the reference's ds_to_universal first"
+        )
+    files = sorted(glob.glob(os.path.join(path, "mp_rank_*_model_states.pt")))
+    if not files:
+        raise FileNotFoundError(f"no mp_rank_*_model_states.pt under {path}")
+    return files
+
+
+def merge_reference_model_states(
+    ckpt_dir: str, model_type: str, tag: Optional[str] = None
+) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+    """Full (TP-merged) torch state dict + meta from a reference checkpoint."""
+    path = _resolve_tag_dir(ckpt_dir, tag)
+    files = _model_state_files(path)
+    states = [_torch_load(f) for f in files]
+    modules = [s.get("module", s) for s in states]
+    tp = len(files)
+    merged: Dict[str, np.ndarray] = {}
+    for name in modules[0]:
+        shards = [_to_numpy(m[name]) for m in modules]
+        axis = tp_merge_axis(name, model_type) if tp > 1 else None
+        if axis is None:
+            merged[name] = shards[0]
+        else:
+            merged[name] = merge_tp_slices(shards, axis=axis)
+    meta = {
+        "tp_degree": tp,
+        "iteration": int(states[0].get("global_steps") or states[0].get("iteration") or 0),
+        "param_shapes": states[0].get("param_shapes"),
+        "dp_world_size": states[0].get("dp_world_size"),
+    }
+    return merged, meta
+
+
+def merge_reference_zero_fp32(
+    ckpt_dir: str, model_type: str, tag: Optional[str] = None
+) -> Dict[str, np.ndarray]:
+    """fp32 masters from the ZeRO stage-1/2 optimizer shards, keyed by the
+    torch param names (the ``ds_to_universal.py`` reconstruction): for each
+    mp rank, concatenate every dp rank's flat partition per group and
+    re-split by the ``param_shapes`` recorded in the model file; then
+    TP-merge across mp ranks."""
+    path = _resolve_tag_dir(ckpt_dir, tag)
+    model_files = _model_state_files(path)
+    per_mp: List[Dict[str, np.ndarray]] = []
+    for mp, mf in enumerate(model_files):
+        shapes_groups = _torch_load(mf).get("param_shapes")
+        if shapes_groups is None:
+            raise ValueError(
+                f"{mf} records no param_shapes; cannot reconstruct fp32 "
+                "masters from flat ZeRO partitions"
+            )
+        zfiles = sorted(
+            glob.glob(os.path.join(path, f"zero_pp_rank_*_mp_rank_{mp:02d}_optim_states.pt")),
+            key=lambda p: int(re.search(r"zero_pp_rank_(\d+)_", p).group(1)),
+        )
+        if not zfiles:
+            raise FileNotFoundError(f"no zero_pp_rank_*_mp_rank_{mp:02d} files under {path}")
+        zstates = [_torch_load(f)["optimizer_state_dict"] for f in zfiles]
+        n_groups = len(shapes_groups)
+        out: Dict[str, np.ndarray] = {}
+        for g in range(n_groups):
+            flat = np.concatenate(
+                [_to_numpy(z["single_partition_of_fp32_groups"][g]).ravel() for z in zstates]
+            )
+            offset = 0
+            for name, shape in shapes_groups[g].items():
+                n = int(np.prod(shape))
+                out[name] = flat[offset : offset + n].reshape(tuple(shape))
+                offset += n
+            # anything past offset is the dp-divisibility padding
+        per_mp.append(out)
+    if len(per_mp) == 1:
+        return per_mp[0]
+    merged: Dict[str, np.ndarray] = {}
+    for name in per_mp[0]:
+        axis = tp_merge_axis(name, model_type)
+        shards = [m[name] for m in per_mp]
+        merged[name] = shards[0] if axis is None else merge_tp_slices(shards, axis=axis)
+    return merged
+
+
+def ingest_reference_checkpoint(
+    ckpt_dir: str,
+    model_config: Any,
+    model_type: Optional[str] = None,
+    tag: Optional[str] = None,
+    use_zero_fp32: bool = True,
+    dtype: Optional[str] = None,
+):
+    """Reference 3D (tp, dp[, pp via consolidation]) checkpoint → fused TPU
+    model + param tree, loadable into ANY mesh (reference
+    ``reshape_meg_2d.py`` + ``universal_checkpoint.py:95`` use case).
+
+    Returns ``(ds_model, params, meta)``. With ``use_zero_fp32`` the weights
+    come from the reconstructed fp32 masters (exact, like the reference's
+    universal path); otherwise from the bf16/fp16 module states."""
+    from deepspeed_tpu.module_inject.replace_module import replace_transformer_layer
+
+    mtype = model_type or getattr(model_config, "model_type", None)
+    if mtype is None:
+        raise ValueError("model_type is required (none found on model_config)")
+    sd, meta = merge_reference_model_states(ckpt_dir, mtype, tag)
+    if use_zero_fp32:
+        try:
+            fp32 = merge_reference_zero_fp32(ckpt_dir, mtype, tag)
+            sd = {**sd, **fp32}
+            meta["weights_from"] = "zero_fp32_masters"
+        except (FileNotFoundError, ValueError):
+            meta["weights_from"] = "module_states"
+    else:
+        meta["weights_from"] = "module_states"
+    ds_model, _ = replace_transformer_layer(model_config=model_config, dtype=dtype)
+    policy = policy_for(mtype)
+    params = policy.convert_weights(sd, ds_model.config)
+    log_dist(
+        f"ingested reference checkpoint: tp={meta['tp_degree']} "
+        f"iteration={meta['iteration']} weights={meta['weights_from']}",
+        ranks=[0],
+    )
+    return ds_model, params, meta
