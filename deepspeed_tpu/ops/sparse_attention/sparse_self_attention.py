@@ -149,12 +149,24 @@ class SparseSelfAttention:
             layout = layout[:1]
         if key_padding_mask is not None and key_padding_mask.dtype != jnp.bool_:
             key_padding_mask = key_padding_mask > 0
+        block = self.sparsity_config.block
+        # the fused Pallas kernel (live-block grid, online softmax) carries
+        # the hot path; key-padding masks and odd blocks fall back to the
+        # XLA dense-gather emulation
+        if key_padding_mask is None and T % block == 0 and block % 8 == 0:
+            from deepspeed_tpu.ops.sparse_attention.pallas_block_sparse import (
+                pallas_block_sparse_attention,
+            )
+
+            return pallas_block_sparse_attention(
+                query, key, value, layout, block, causal=causal
+            )
         return block_sparse_attention(
             query,
             key,
             value,
             layout,
-            self.sparsity_config.block,
+            block,
             causal=causal,
             key_padding_mask=key_padding_mask,
         )
