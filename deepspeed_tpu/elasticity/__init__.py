@@ -8,3 +8,4 @@ from deepspeed_tpu.elasticity.elasticity import (
     get_compatible_gpus_v02,
 )
 from deepspeed_tpu.elasticity.config import ElasticityConfig, ElasticityConfigError, ElasticityError
+from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent, WorkerSpec

@@ -1,0 +1,160 @@
+"""Elastic agent (reference: ``deepspeed/elasticity/elastic_agent.py:28``
+``DSElasticAgent`` extending torch-elastic's ``LocalElasticAgent``).
+
+The reference's agent glues two things together: (a) the elasticity batch
+math — a membership change must land on a world size whose schedule keeps
+the global batch fixed — and (b) worker lifecycle: per-worker env assembly
+and restart on resize. On TPU there is no torch-elastic; the agent drives
+the launcher's per-host process model directly. Worker spawn/kill are
+injectable so resize logic is testable without real processes (the
+launcher passes subprocess-based implementations).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+from deepspeed_tpu.elasticity.elasticity import compute_elastic_config
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+class WorkerSpec:
+    """Minimal spec (reference torch-elastic ``WorkerSpec`` surface)."""
+
+    def __init__(
+        self,
+        entrypoint: List[str],
+        local_world_size: int = 1,
+        max_restarts: int = 100,
+        master_addr: Optional[str] = None,
+        master_port: int = 29500,
+    ):
+        self.entrypoint = list(entrypoint)
+        self.local_world_size = local_world_size
+        self.max_restarts = max_restarts
+        self.master_addr = master_addr or "127.0.0.1"
+        self.master_port = master_port
+
+
+class DSElasticAgent:
+    """Membership-aware launcher: recomputes the elastic schedule on every
+    resize and restarts workers with the new (world, micro-batch, gas) env.
+
+    ``spawn_fn(cmd, env) -> handle`` and ``kill_fn(handle)`` default to
+    subprocess implementations; tests inject fakes.
+    """
+
+    def __init__(
+        self,
+        spec: WorkerSpec,
+        ds_config: Dict[str, Any],
+        env: Optional[Dict[str, str]] = None,
+        spawn_fn: Optional[Callable] = None,
+        kill_fn: Optional[Callable] = None,
+    ):
+        elastic = ds_config.get("elasticity", {})
+        if not elastic.get("enabled", False):
+            raise ValueError("DSElasticAgent requires elasticity.enabled in the config")
+        self.spec = spec
+        self.ds_config = ds_config
+        self.ds_env = dict(env or {})
+        self.restart_count = 0
+        self._workers: List[Any] = []
+        self.world_size = 0
+        self._spawn = spawn_fn or self._default_spawn
+        self._kill = kill_fn or self._default_kill
+
+    # --- spawn/kill defaults -------------------------------------------
+    @staticmethod
+    def _default_spawn(cmd: List[str], env: Dict[str, str]):
+        import subprocess
+
+        return subprocess.Popen(cmd, env={**os.environ, **env})
+
+    @staticmethod
+    def _default_kill(handle) -> None:
+        try:
+            handle.terminate()
+            handle.wait(timeout=30)
+        except Exception:
+            logger.warning("worker did not terminate cleanly")
+
+    # --- schedule -------------------------------------------------------
+    def schedule_for(self, world_size: int) -> Dict[str, int]:
+        """(batch, micro, gas) for a world size; raises if the size is not in
+        the elastic-compatible set (reference schedule recomputation)."""
+        batch, valid, micro = compute_elastic_config(
+            self.ds_config,
+            target_deepspeed_version="0.10.2",
+            world_size=world_size,
+            return_microbatch=True,
+        )
+        gas = max(1, batch // max(1, micro * world_size))
+        return {
+            "train_batch_size": batch,
+            "train_micro_batch_size_per_gpu": micro,
+            "gradient_accumulation_steps": gas,
+            "world_size": world_size,
+        }
+
+    def _worker_env(self, rank: int, world_size: int, sched: Dict[str, int]) -> Dict[str, str]:
+        env = dict(self.ds_env)
+        env.update(
+            {
+                "RANK": str(rank),
+                "LOCAL_RANK": str(rank % self.spec.local_world_size),
+                "WORLD_SIZE": str(world_size),
+                "LOCAL_WORLD_SIZE": str(self.spec.local_world_size),
+                "MASTER_ADDR": self.spec.master_addr,
+                "MASTER_PORT": str(self.spec.master_port),
+                "DS_ELASTIC_RESTART_COUNT": str(self.restart_count),
+                "DS_ELASTIC_TRAIN_BATCH_SIZE": str(sched["train_batch_size"]),
+                "DS_ELASTIC_MICRO_BATCH": str(sched["train_micro_batch_size_per_gpu"]),
+                "DS_ELASTIC_GAS": str(sched["gradient_accumulation_steps"]),
+            }
+        )
+        return env
+
+    # --- lifecycle ------------------------------------------------------
+    def start(self, world_size: int) -> Dict[str, int]:
+        sched = self.schedule_for(world_size)
+        for rank in range(world_size):
+            env = self._worker_env(rank, world_size, sched)
+            self._workers.append(self._spawn(self.spec.entrypoint, env))
+        self.world_size = world_size
+        log_dist(
+            f"DSElasticAgent: started {world_size} workers "
+            f"(batch {sched['train_batch_size']} = micro {sched['train_micro_batch_size_per_gpu']} "
+            f"x gas {sched['gradient_accumulation_steps']} x {world_size})",
+            ranks=[0],
+        )
+        return sched
+
+    def on_membership_change(self, new_world_size: int) -> Dict[str, int]:
+        """Resize: validate the new world against the elastic set FIRST
+        (an invalid size must not kill the running job), then restart every
+        worker with the recomputed schedule (checkpoint-resume is the
+        workers' job, as in the reference)."""
+        if new_world_size == self.world_size:
+            return self.schedule_for(self.world_size)
+        if self.restart_count >= self.spec.max_restarts:
+            raise RuntimeError(f"exceeded max_restarts={self.spec.max_restarts}")
+        sched = self.schedule_for(new_world_size)  # raises on invalid size
+        self.stop()
+        self.restart_count += 1
+        for rank in range(new_world_size):
+            env = self._worker_env(rank, new_world_size, sched)
+            self._workers.append(self._spawn(self.spec.entrypoint, env))
+        self.world_size = new_world_size
+        log_dist(
+            f"DSElasticAgent: resized to {new_world_size} workers "
+            f"(restart {self.restart_count})",
+            ranks=[0],
+        )
+        return sched
+
+    def stop(self) -> None:
+        for h in self._workers:
+            self._kill(h)
+        self._workers = []

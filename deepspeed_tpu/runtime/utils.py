@@ -1,0 +1,138 @@
+"""Runtime utilities (reference: ``deepspeed/runtime/utils.py``).
+
+The MP-aware grad clipping lives inside the jitted step
+(``engine.update_from_grads``); this module carries the user-facing
+surfaces: ``see_memory_usage`` (device + host memory report),
+``CheckOverflow`` (grad-overflow scan), ``clip_grad_norm_`` (functional,
+global-norm over a grad tree), and the ZeRO memory estimators re-exported
+from the partitioner.
+"""
+
+from __future__ import annotations
+
+import gc
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.runtime.zero.partition import estimate_zero_memory
+from deepspeed_tpu.utils.logging import logger
+
+__all__ = [
+    "see_memory_usage",
+    "CheckOverflow",
+    "clip_grad_norm_",
+    "global_grad_norm",
+    "estimate_zero_memory",
+    "call_to_str",
+]
+
+
+def _device_memory_stats() -> dict:
+    """Per-device HBM stats where the backend exposes them (TPU does);
+    falls back to summing live jax.Array footprints."""
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+        if stats:
+            return {
+                "bytes_in_use": stats.get("bytes_in_use", 0),
+                "peak_bytes_in_use": stats.get("peak_bytes_in_use", 0),
+                "bytes_limit": stats.get("bytes_limit", 0),
+            }
+    except Exception:
+        pass
+    live = 0
+    for arr in jax.live_arrays():
+        live += arr.size * arr.dtype.itemsize
+    return {"bytes_in_use": live, "peak_bytes_in_use": 0, "bytes_limit": 0}
+
+
+def see_memory_usage(message: str, force: bool = False) -> Optional[dict]:
+    """Log device HBM + host RAM usage (reference ``see_memory_usage``).
+    Returns the stats dict (handy for tests); None when not forced."""
+    if not force:
+        return None
+    from deepspeed_tpu import comm as dist
+
+    if dist.is_initialized() and dist.get_rank() != 0:
+        return None
+    gc.collect()
+    dev = _device_memory_stats()
+    GB = 1024**3
+    logger.info(message)
+    logger.info(
+        f"MA {dev['bytes_in_use'] / GB:.2f} GB  "
+        f"Max_MA {dev['peak_bytes_in_use'] / GB:.2f} GB  "
+        f"Limit {dev['bytes_limit'] / GB:.2f} GB"
+    )
+    try:
+        import psutil
+
+        vm = psutil.virtual_memory()
+        used_gb = (vm.total - vm.available) / GB
+        logger.info(f"CPU Virtual Memory:  used = {used_gb:.2f} GB, percent = {vm.percent}%")
+        dev["host_used_bytes"] = vm.total - vm.available
+    except ImportError:
+        pass
+    return dev
+
+
+def global_grad_norm(grads: Any) -> jnp.ndarray:
+    """Global L2 norm over a grad pytree. Full reductions over sharded
+    leaves are global under GSPMD — no explicit MP all-reduce needed
+    (the reference's mpu-aware ``get_grad_norm``)."""
+    sq = sum(
+        jnp.sum(jnp.square(g.astype(jnp.float32)))
+        for g in jax.tree_util.tree_leaves(grads)
+    )
+    return jnp.sqrt(sq)
+
+
+def clip_grad_norm_(grads: Any, max_norm: float, norm: Optional[jnp.ndarray] = None):
+    """Functional grad clipping: returns (clipped_grads, global_norm)
+    (reference ``clip_grad_norm_``, which mutates; pytrees are immutable)."""
+    total = global_grad_norm(grads) if norm is None else norm
+    coef = jnp.minimum(1.0, max_norm / (total + 1e-6))
+    return jax.tree_util.tree_map(lambda g: g * coef, grads), total
+
+
+class CheckOverflow:
+    """Grad-overflow scan (reference ``CheckOverflow``). Under GSPMD a full
+    reduction over sharded grads is already global, so the reference's
+    cross-process all-reduces collapse into the jnp reductions."""
+
+    def __init__(self, param_groups=None, mpu=None, zero_reduce_scatter=False, deepspeed=None):  # noqa: ARG002
+        self.params = param_groups
+
+    @staticmethod
+    def has_overflow(grads: Any) -> bool:
+        from deepspeed_tpu.runtime.fp16.loss_scaler import has_inf_or_nan
+
+        if grads is None:
+            return False
+        return bool(jax.device_get(has_inf_or_nan(grads)))
+
+    @staticmethod
+    def check_using_norm(norm_group: Sequence[float]) -> bool:
+        """-1 in a norm group marks an overflowed partition (reference
+        semantics)."""
+        arr = np.asarray(list(norm_group), dtype=np.float64)
+        return bool((arr == -1).any() or ~np.isfinite(arr).all())
+
+    def check(self, param_groups=None) -> bool:
+        groups = param_groups if param_groups is not None else self.params
+        return self.has_overflow(groups)
+
+
+def call_to_str(base: str, *args, **kwargs) -> str:
+    """'fn(a, b, k=v)' debug formatting (reference ``call_to_str``)."""
+    name = f"{base}("
+    if args:
+        name += ", ".join(str(arg) for arg in args)
+        if kwargs:
+            name += ", "
+    if kwargs:
+        name += ", ".join(f"{k}={v}" for k, v in kwargs.items())
+    return name + ")"

@@ -1,0 +1,140 @@
+"""Tiled linear (reference: ``deepspeed/runtime/zero/tiling.py``).
+
+The reference breaks a huge ``nn.Linear`` into an (in_splits × out_splits)
+tile grid so ZeRO-3 can partition/offload inactive tiles. Under GSPMD the
+partitioner already shards any matmul, so tiling buys nothing for sharding
+— what survives is the API (models written against TiledLinear port
+unchanged) and the memory shape: per-tile params mean per-tile gathers
+under ZeRO-3 instead of one monolithic gather.
+
+Functional: ``init(rng)`` builds the tile tree, ``apply(params, x)`` runs
+the tile grid with fp32 partial-sum accumulation over in-tiles.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def split_tensor_along_last_dim(tensor: jnp.ndarray, partitions: int, contiguous_split_chunks: bool = False):  # noqa: ARG001
+    """Reference helper: split the last dim into ``partitions`` chunks."""
+    return jnp.split(tensor, partitions, axis=-1)
+
+
+def partition_uniform(num_items: int, num_parts: int) -> List[int]:
+    """Boundaries of a near-uniform split (reference partition helper)."""
+    base = num_items // num_parts
+    extra = num_items % num_parts
+    bounds = [0]
+    for p in range(num_parts):
+        bounds.append(bounds[-1] + base + (1 if p < extra else 0))
+    return bounds
+
+
+class TiledLinear:
+    """y = x @ W.T + b computed as an (out_splits × in_splits) tile grid.
+
+    Matches the reference's semantics: input split along its last dim into
+    ``in_splits`` chunks, each out-tile sums its in-tiles' partial products,
+    outputs concatenated unless ``combine_out_splits=False``.
+    """
+
+    def __init__(
+        self,
+        in_features: int,
+        out_features: int,
+        bias: bool = True,
+        in_splits: int = 1,
+        out_splits: int = 1,
+        input_is_already_split: bool = False,
+        combine_out_splits: bool = True,
+    ):
+        if in_splits < 1 or out_splits < 1:
+            raise ValueError("in_splits and out_splits must be >= 1")
+        self.in_features = in_features
+        self.out_features = out_features
+        self.use_bias = bias
+        self.in_splits = in_splits
+        self.out_splits = out_splits
+        self.input_is_already_split = input_is_already_split
+        self.combine_out_splits = combine_out_splits
+        self.in_bounds = partition_uniform(in_features, in_splits)
+        self.out_bounds = partition_uniform(out_features, out_splits)
+
+    def init(self, rng, std: float = 0.02) -> Dict[str, Any]:
+        tiles = {}
+        keys = jax.random.split(rng, self.in_splits * self.out_splits)
+        ki = 0
+        for o in range(self.out_splits):
+            for i in range(self.in_splits):
+                o0, o1 = self.out_bounds[o], self.out_bounds[o + 1]
+                i0, i1 = self.in_bounds[i], self.in_bounds[i + 1]
+                tiles[f"tile_{o}_{i}"] = (
+                    jax.random.normal(keys[ki], (i1 - i0, o1 - o0), jnp.float32) * std
+                )
+                ki += 1
+        params: Dict[str, Any] = {"tiles": tiles}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.out_features,), jnp.float32)
+        return params
+
+    def from_full(self, weight: np.ndarray, bias: Optional[np.ndarray] = None) -> Dict[str, Any]:
+        """Tile a full [in, out] weight (reference ``init_linear`` copy)."""
+        if weight.shape != (self.in_features, self.out_features):
+            raise ValueError(f"expected [in, out] = {(self.in_features, self.out_features)}, got {weight.shape}")
+        tiles = {}
+        for o in range(self.out_splits):
+            for i in range(self.in_splits):
+                o0, o1 = self.out_bounds[o], self.out_bounds[o + 1]
+                i0, i1 = self.in_bounds[i], self.in_bounds[i + 1]
+                tiles[f"tile_{o}_{i}"] = jnp.asarray(weight[i0:i1, o0:o1])
+        params: Dict[str, Any] = {"tiles": tiles}
+        if self.use_bias:
+            params["bias"] = (
+                jnp.asarray(bias) if bias is not None else jnp.zeros((self.out_features,), jnp.float32)
+            )
+        return params
+
+    def apply(self, params: Dict[str, Any], x):
+        if self.input_is_already_split:
+            chunks = list(x)
+        elif self.in_splits > 1:
+            chunks = [
+                x[..., self.in_bounds[i] : self.in_bounds[i + 1]]
+                for i in range(self.in_splits)
+            ]
+        else:
+            chunks = [x]
+        outs = []
+        for o in range(self.out_splits):
+            acc = None
+            for i in range(self.in_splits):
+                part = jnp.dot(
+                    chunks[i],
+                    params["tiles"][f"tile_{o}_{i}"].astype(chunks[i].dtype),
+                    preferred_element_type=jnp.float32,
+                )
+                acc = part if acc is None else acc + part
+            if self.use_bias:
+                o0, o1 = self.out_bounds[o], self.out_bounds[o + 1]
+                acc = acc + params["bias"][o0:o1].astype(jnp.float32)
+            outs.append(acc.astype(x[0].dtype if isinstance(x, (list, tuple)) else x.dtype))
+        if self.combine_out_splits:
+            return jnp.concatenate(outs, axis=-1)
+        return outs
+
+
+class TiledLinearReturnBias(TiledLinear):
+    """Megatron-style variant: returns (output, bias) without adding it."""
+
+    def apply(self, params, x):
+        use_bias, self.use_bias = self.use_bias, False
+        try:
+            out = super().apply(params, x)
+        finally:
+            self.use_bias = use_bias
+        return out, params.get("bias")

@@ -28,6 +28,7 @@ from deepspeed_tpu.runtime.zero.offload_config import (
     OffloadDeviceEnum,
 )
 from deepspeed_tpu.runtime.zero.partition import ZeroPartitioner, estimate_zero_memory
+from deepspeed_tpu.runtime.zero.tiling import TiledLinear, TiledLinearReturnBias
 
 _init_ctx_active = False
 
@@ -139,4 +140,6 @@ __all__ = [
     "DeepSpeedZeroOffloadParamConfig",
     "DeepSpeedZeroOffloadOptimizerConfig",
     "shutdown_init_context",
+    "TiledLinear",
+    "TiledLinearReturnBias",
 ]

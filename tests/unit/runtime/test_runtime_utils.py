@@ -1,0 +1,191 @@
+"""Runtime utils, tiled linear, contiguous allocator, elastic agent tests.
+
+Reference analogs: ``tests/unit/runtime/test_runtime_utils.py`` (clip/norm/
+CheckOverflow), ``tests/unit/runtime/zero/test_tiling.py``, the allocator's
+in-file sanity harness, and ``deepspeed/elasticity/elastic_agent.py``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent, WorkerSpec
+from deepspeed_tpu.runtime.utils import (
+    CheckOverflow,
+    call_to_str,
+    clip_grad_norm_,
+    global_grad_norm,
+    see_memory_usage,
+)
+from deepspeed_tpu.runtime.zero.contiguous_memory_allocator import (
+    ContiguousMemoryAllocator,
+)
+from deepspeed_tpu.runtime.zero.tiling import TiledLinear, TiledLinearReturnBias
+
+
+class TestRuntimeUtils:
+    def test_clip_grad_norm(self):
+        grads = {"a": jnp.full((4,), 3.0), "b": jnp.full((4,), 4.0)}
+        clipped, norm = clip_grad_norm_(grads, max_norm=1.0)
+        np.testing.assert_allclose(float(norm), 10.0, rtol=1e-6)
+        np.testing.assert_allclose(float(global_grad_norm(clipped)), 1.0, rtol=1e-4)
+        # under the max: untouched
+        same, _ = clip_grad_norm_(grads, max_norm=100.0)
+        np.testing.assert_allclose(np.asarray(same["a"]), 3.0)
+
+    def test_check_overflow(self):
+        ok = {"w": jnp.ones((4,))}
+        bad = {"w": jnp.array([1.0, jnp.nan, 2.0, 3.0])}
+        assert CheckOverflow.has_overflow(ok) is False
+        assert CheckOverflow.has_overflow(bad) is True
+        assert CheckOverflow.check_using_norm([1.0, 2.0]) is False
+        assert CheckOverflow.check_using_norm([1.0, -1]) is True
+        assert CheckOverflow.check_using_norm([float("nan")]) is True
+
+    def test_see_memory_usage(self):
+        assert see_memory_usage("quiet") is None  # not forced: no-op
+        stats = see_memory_usage("forced", force=True)
+        assert stats is not None and stats["bytes_in_use"] >= 0
+
+    def test_call_to_str(self):
+        assert call_to_str("f", 1, "x", k=2) == "f(1, x, k=2)"
+
+
+class TestTiledLinear:
+    @pytest.mark.parametrize("in_splits,out_splits", [(1, 1), (2, 3), (4, 2)])
+    def test_matches_dense(self, in_splits, out_splits):
+        tl = TiledLinear(24, 36, in_splits=in_splits, out_splits=out_splits)
+        rs = np.random.RandomState(0)
+        w = rs.randn(24, 36).astype(np.float32)
+        b = rs.randn(36).astype(np.float32)
+        params = tl.from_full(w, b)
+        x = jnp.asarray(rs.randn(5, 24).astype(np.float32))
+        out = tl.apply(params, x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x) @ w + b, rtol=1e-5, atol=1e-5)
+
+    def test_uneven_splits(self):
+        tl = TiledLinear(10, 7, in_splits=3, out_splits=2)  # non-divisible dims
+        rs = np.random.RandomState(1)
+        w = rs.randn(10, 7).astype(np.float32)
+        params = tl.from_full(w)
+        x = jnp.asarray(rs.randn(2, 10).astype(np.float32))
+        np.testing.assert_allclose(
+            np.asarray(tl.apply(params, x)), np.asarray(x) @ w + 0.0, rtol=1e-5, atol=1e-5
+        )
+
+    def test_return_bias_variant(self):
+        tl = TiledLinearReturnBias(8, 8, in_splits=2, out_splits=2)
+        params = tl.init(jax.random.PRNGKey(0))
+        x = jnp.ones((2, 8))
+        out, bias = tl.apply(params, x)
+        assert out.shape == (2, 8) and bias.shape == (8,)
+
+    def test_grad_flows(self):
+        tl = TiledLinear(8, 8, in_splits=2, out_splits=2)
+        params = tl.init(jax.random.PRNGKey(0))
+        g = jax.grad(lambda p, x: jnp.sum(tl.apply(p, x) ** 2))(params, jnp.ones((2, 8)))
+        assert all(np.isfinite(np.asarray(l)).all() for l in jax.tree_util.tree_leaves(g))
+
+
+class TestContiguousMemoryAllocator:
+    def test_allocate_release(self):
+        al = ContiguousMemoryAllocator(100)
+        a = al.allocate_tensor(40)
+        b = al.allocate_tensor(30)
+        assert a.size == 40 and b.size == 30
+        assert al.available_memory == 30
+        al.release_tensor(a)
+        assert al.available_memory == 70
+
+    def test_oom_raises(self):
+        al = ContiguousMemoryAllocator(10)
+        al.allocate_tensor(8)
+        with pytest.raises(RuntimeError, match="out of memory"):
+            al.allocate_tensor(4)
+
+    def test_defragment_preserves_contents(self):
+        al = ContiguousMemoryAllocator(100)
+        a = al.allocate_tensor(40)
+        b = al.allocate_tensor(30)
+        a_id, b_id = al.tensor_id(a), al.tensor_id(b)
+        b[:] = 7.0
+        al.release_tensor(a)  # hole [0:40), free tail [70:100)
+        # 60 won't fit any hole but fits total free: triggers defragment
+        c = al.allocate_tensor(60)
+        assert c.size == 60
+        np.testing.assert_array_equal(al.get_tensor(b_id), 7.0)  # moved, intact
+        assert al.available_memory == 10
+
+
+ELASTIC_CFG = {
+    "elasticity": {
+        "enabled": True,
+        "max_train_batch_size": 64,
+        "micro_batch_sizes": [2, 4],
+        "min_gpus": 1,
+        "max_gpus": 16,
+        "min_time": 0,
+        "version": 0.1,
+    }
+}
+
+
+class TestDSElasticAgent:
+    def _agent(self):
+        spawned, killed = [], []
+        agent = DSElasticAgent(
+            WorkerSpec(entrypoint=["python", "train.py"], max_restarts=3),
+            ELASTIC_CFG,
+            env={"BASE": "1"},
+            spawn_fn=lambda cmd, env: spawned.append((cmd, env)) or len(spawned),
+            kill_fn=lambda h: killed.append(h),
+        )
+        return agent, spawned, killed
+
+    def test_start_spawns_world(self):
+        agent, spawned, _ = self._agent()
+        sched = agent.start(4)
+        assert len(spawned) == 4
+        env0 = spawned[0][1]
+        assert env0["RANK"] == "0" and env0["WORLD_SIZE"] == "4"
+        assert int(env0["DS_ELASTIC_TRAIN_BATCH_SIZE"]) == sched["train_batch_size"]
+        # schedule consistency: batch = micro x gas x world
+        assert (
+            sched["train_batch_size"]
+            == sched["train_micro_batch_size_per_gpu"]
+            * sched["gradient_accumulation_steps"]
+            * 4
+        )
+
+    def test_resize_restarts_with_new_schedule(self):
+        agent, spawned, killed = self._agent()
+        agent.start(4)
+        sched = agent.on_membership_change(8)
+        assert len(killed) == 4  # old workers stopped
+        assert len(spawned) == 12  # 4 old + 8 new
+        assert agent.restart_count == 1
+        assert spawned[-1][1]["WORLD_SIZE"] == "8"
+        # global batch preserved across the resize
+        first = agent.schedule_for(4)
+        assert sched["train_batch_size"] == first["train_batch_size"]
+
+    def test_invalid_world_does_not_kill_job(self):
+        agent, spawned, killed = self._agent()
+        agent.start(4)
+        with pytest.raises(Exception):
+            agent.on_membership_change(5)  # 5 not in the compatible set
+        assert len(killed) == 0, "running workers must survive a bad resize"
+
+    def test_max_restarts(self):
+        agent, _, _ = self._agent()
+        agent.start(2)
+        agent.spec.max_restarts = 0
+        with pytest.raises(RuntimeError, match="max_restarts"):
+            agent.on_membership_change(4)
+
+    def test_requires_elasticity_enabled(self):
+        with pytest.raises(ValueError, match="elasticity"):
+            DSElasticAgent(WorkerSpec(["x"]), {"elasticity": {"enabled": False}})
