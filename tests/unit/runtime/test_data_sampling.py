@@ -1,0 +1,134 @@
+"""Indexed dataset + data analyzer tests (reference:
+``tests/unit/runtime/test_data_efficiency.py`` analysis paths)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.data_pipeline.data_sampling import (
+    DataAnalyzer,
+    MMapIndexedDataset,
+    MMapIndexedDatasetBuilder,
+    make_builder,
+    make_dataset,
+)
+
+
+def _build(tmp_path, seqs, dtype=np.int32, docs=None):
+    import os
+
+    os.makedirs(str(tmp_path), exist_ok=True)
+    prefix = str(tmp_path / "corpus")
+    b = MMapIndexedDatasetBuilder(prefix + ".bin", dtype=dtype)
+    for i, s in enumerate(seqs):
+        b.add_item(s)
+        if docs and i in docs:
+            b.end_document()
+    if not docs:
+        b.end_document()
+    b.finalize(prefix + ".idx")
+    return prefix
+
+
+class TestMMapIndexedDataset:
+    def test_roundtrip(self, tmp_path):
+        seqs = [[1, 2, 3], [4, 5], [6, 7, 8, 9], [10]]
+        prefix = _build(tmp_path, seqs)
+        ds = MMapIndexedDataset(prefix)
+        assert len(ds) == 4
+        for i, s in enumerate(seqs):
+            np.testing.assert_array_equal(ds[i], np.asarray(s, np.int32))
+        np.testing.assert_array_equal(ds.sizes, [3, 2, 4, 1])
+        np.testing.assert_array_equal(ds.get(2, offset=1, length=2), [7, 8])
+
+    def test_dtype_uint16(self, tmp_path):
+        prefix = _build(tmp_path, [[65535, 1], [7]], dtype=np.uint16)
+        ds = MMapIndexedDataset(prefix)
+        assert ds.dtype == np.uint16
+        np.testing.assert_array_equal(ds[0], np.asarray([65535, 1], np.uint16))
+
+    def test_reference_format_compatibility(self, tmp_path):
+        """Byte-level layout check against the documented MMIDIDX header."""
+        import struct
+
+        prefix = _build(tmp_path, [[1, 2], [3]])
+        raw = open(prefix + ".idx", "rb").read()
+        assert raw[:9] == b"MMIDIDX\x00\x00"
+        assert struct.unpack("<Q", raw[9:17]) == (1,)
+        assert raw[17] == 4  # dtype code for int32
+        assert struct.unpack("<Q", raw[18:26]) == (2,)  # n sequences
+        bin_raw = np.fromfile(prefix + ".bin", dtype=np.int32)
+        np.testing.assert_array_equal(bin_raw, [1, 2, 3])
+
+    def test_merge(self, tmp_path):
+        p1 = _build(tmp_path / "a", [[1, 2]])
+        p2 = _build(tmp_path / "b", [[3], [4, 5]])
+        out = str(tmp_path / "merged")
+        b = make_builder(out + ".bin")
+        b.merge_file_(p1)
+        b.merge_file_(p2)
+        b.finalize(out + ".idx")
+        ds = make_dataset(out)
+        assert len(ds) == 3
+        np.testing.assert_array_equal(ds[2], [4, 5])
+
+    def test_exists(self, tmp_path):
+        prefix = _build(tmp_path, [[1]])
+        assert MMapIndexedDataset.exists(prefix)
+        assert not MMapIndexedDataset.exists(str(tmp_path / "nope"))
+
+
+class TestDataAnalyzer:
+    def _dataset(self):
+        rs = np.random.RandomState(0)
+        return [rs.randint(0, 50, size=rs.randint(2, 10)) for _ in range(23)]
+
+    @pytest.mark.parametrize("workers", [1, 3])
+    def test_seqlen_metric(self, tmp_path, workers):
+        data = self._dataset()
+        an = DataAnalyzer(
+            data,
+            num_workers=workers,
+            metric_names=["seqlen"],
+            metric_functions=[len],
+            metric_types=["single_value_per_sample"],
+            save_path=str(tmp_path),
+        )
+        an.run()
+        s2m = an.load_sample_to_metric("seqlen")
+        np.testing.assert_array_equal(s2m, [len(s) for s in data])
+        m2s = an.load_metric_to_sample("seqlen")
+        values = an.load_metric_values("seqlen")
+        # each value's bucket lists exactly the samples with that length
+        for vi, v in enumerate(values):
+            np.testing.assert_array_equal(
+                m2s[vi], np.nonzero(s2m == v)[0].astype(np.int64)
+            )
+
+    def test_accumulate_metric(self, tmp_path):
+        data = self._dataset()
+        an = DataAnalyzer(
+            data,
+            num_workers=2,
+            metric_names=["token_hist"],
+            metric_functions=[lambda s: np.bincount(s, minlength=50)],
+            metric_types=["accumulate_value_over_samples"],
+            save_path=str(tmp_path),
+        )
+        an.run()
+        hist = an.load_accumulate("token_hist")
+        expected = np.zeros(50, np.int64)
+        for s in data:
+            expected += np.bincount(s, minlength=50)
+        np.testing.assert_array_equal(hist, expected)
+
+    def test_unknown_metric_type_raises(self, tmp_path):
+        with pytest.raises(ValueError, match="metric_type"):
+            DataAnalyzer(
+                [],
+                metric_names=["x"],
+                metric_functions=[len],
+                metric_types=["bogus"],
+                save_path=str(tmp_path),
+            )
