@@ -7,3 +7,10 @@ from deepspeed_tpu.autotuning.autotuner import (
     RandomTuner,
     run_autotuning,
 )
+from deepspeed_tpu.autotuning.config_templates import (
+    STAGE_TEMPLATES,
+    candidate_configs,
+    merge_config,
+    template_for_stage,
+)
+from deepspeed_tpu.autotuning.scheduler import Experiment, ExpStatus, ResourceManager

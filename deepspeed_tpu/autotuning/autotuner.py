@@ -130,21 +130,22 @@ class Autotuner:
 
     # --- candidate grid ---------------------------------------------------
     def generate_experiments(self) -> List[Dict]:
+        """(stage, micro) sweep with the per-stage tuning templates applied
+        (reference ``config_templates/``), memory-gated per candidate."""
+        from deepspeed_tpu.autotuning.config_templates import candidate_configs
+
         info = self.model_info()
         n_params = info["num_params"]
         import jax
 
         dp = len(jax.devices())
         exps = []
-        for stage, micro in itertools.product(self.stages, self.micro_batches):
+        for cfg in candidate_configs(self.base_config, self.stages, self.micro_batches):
+            stage = cfg["zero_optimization"]["stage"]
             mem = estimate_zero_memory(n_params, stage, dp)["total_bytes"]
             if mem > self.hbm_bytes:
                 logger.debug(f"skip stage={stage} (needs {mem/2**30:.1f} GiB)")
                 continue
-            cfg = dict(self.base_config)
-            cfg["train_micro_batch_size_per_gpu"] = micro
-            cfg["zero_optimization"] = dict(cfg.get("zero_optimization", {}), stage=stage)
-            cfg.pop("train_batch_size", None)
             exps.append(cfg)
         return exps
 
@@ -197,16 +198,21 @@ class Autotuner:
         }
 
     def tune(self) -> Optional[Dict]:
+        from deepspeed_tpu.autotuning.scheduler import ResourceManager
+
         exps = self.generate_experiments()
         logger.info(f"autotuning over {len(exps)} candidate configs")
         tuner = self._make_tuner(exps)
+        # the scheduler owns execution/status; the tuner owns the visit order
+        self.scheduler = ResourceManager(self.run_trial, num_slots=1)
         trials = 0
         while tuner.has_next() and trials < self.max_trials:
-            for config in tuner.next_batch(1):
-                result = self.run_trial(config)
-                trials += 1
-                if result is not None:
-                    self.results.append(result)
+            batch = tuner.next_batch(1)
+            self.scheduler.schedule_all(batch)
+            trials += len(batch)
+        for exp in self.scheduler.run():
+            if exp.result is not None:
+                self.results.append(exp.result)
         if not self.results:
             return None
         if self.metric == AUTOTUNING_METRIC_LATENCY:

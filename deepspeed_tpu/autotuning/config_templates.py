@@ -1,0 +1,87 @@
+"""Per-stage tuning templates (reference:
+``deepspeed/autotuning/config_templates/template_zero{0..3}.json``).
+
+Each template is the set of stage-specific knobs worth sweeping; the
+autotuner overlays them onto the user's base config when generating
+candidates. Values are TPU-adjusted: bucket sizes steer XLA's collective
+combining rather than NCCL chunking, and ``overlap_comm`` maps onto the
+latency-hiding scheduler (always profitable, so stage-3 sweeps it on)."""
+
+from __future__ import annotations
+
+import copy
+from typing import Dict, List
+
+TEMPLATE_ZERO0: Dict = {"zero_optimization": {"stage": 0}}
+
+TEMPLATE_ZERO1: Dict = {
+    "zero_optimization": {
+        "stage": 1,
+        "reduce_bucket_size": int(5e8),
+        "allgather_bucket_size": int(5e8),
+    }
+}
+
+TEMPLATE_ZERO2: Dict = {
+    "zero_optimization": {
+        "stage": 2,
+        "allgather_partitions": True,
+        "allgather_bucket_size": int(5e8),
+        "overlap_comm": False,
+        "reduce_scatter": True,
+        "reduce_bucket_size": int(5e8),
+        "contiguous_gradients": False,
+    }
+}
+
+TEMPLATE_ZERO3: Dict = {
+    "zero_optimization": {
+        "stage": 3,
+        "overlap_comm": True,
+        "reduce_bucket_size": int(5e8),
+        "stage3_prefetch_bucket_size": int(5e7),
+        "stage3_param_persistence_threshold": int(1e5),
+        "stage3_max_live_parameters": int(1e9),
+        "stage3_max_reuse_distance": int(1e9),
+    }
+}
+
+STAGE_TEMPLATES: Dict[int, Dict] = {
+    0: TEMPLATE_ZERO0,
+    1: TEMPLATE_ZERO1,
+    2: TEMPLATE_ZERO2,
+    3: TEMPLATE_ZERO3,
+}
+
+
+def template_for_stage(stage: int) -> Dict:
+    if stage not in STAGE_TEMPLATES:
+        raise ValueError(f"no tuning template for zero stage {stage}")
+    return copy.deepcopy(STAGE_TEMPLATES[stage])
+
+
+def merge_config(base: Dict, overlay: Dict) -> Dict:
+    """Recursive dict merge, overlay wins; user-set keys in ``base`` win over
+    template defaults (the reference keeps user values)."""
+    out = copy.deepcopy(overlay)
+    for k, v in base.items():
+        if k in out and isinstance(out[k], dict) and isinstance(v, dict):
+            out[k] = merge_config(v, out[k])
+        else:
+            out[k] = copy.deepcopy(v)
+    return out
+
+
+def candidate_configs(base: Dict, stages: List[int], micro_batches: List[int]) -> List[Dict]:
+    """The (stage, micro-batch) sweep with stage templates applied."""
+    out = []
+    for stage in stages:
+        tpl = template_for_stage(stage)
+        for micro in micro_batches:
+            cfg = merge_config(base, tpl)
+            # the sweep owns the stage and micro-batch choices
+            cfg["zero_optimization"]["stage"] = stage
+            cfg["train_micro_batch_size_per_gpu"] = micro
+            cfg.pop("train_batch_size", None)
+            out.append(cfg)
+    return out

@@ -1,0 +1,121 @@
+"""Experiment scheduler over a resource pool (reference:
+``deepspeed/autotuning/scheduler.py`` ``ResourceManager``).
+
+The reference schedules tuning experiments across reserved node groups via
+ssh; here a resource is any experiment-executor slot (on one TPU host:
+usually 1 — trials share the chip serially; in a pod: one slot per slice).
+Experiments carry QUEUED → RUNNING → DONE/FAILED state, results collect as
+they finish, and the caller's tuner drains the queue in arrival order.
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+
+class ExpStatus(str, enum.Enum):
+    QUEUED = "queued"
+    RUNNING = "running"
+    DONE = "done"
+    FAILED = "failed"
+
+
+class Experiment:
+    _next_id = 0
+
+    def __init__(self, config: Dict):
+        Experiment._next_id += 1
+        self.exp_id = Experiment._next_id
+        self.config = config
+        self.status = ExpStatus.QUEUED
+        self.result: Optional[Dict] = None
+        self.error: Optional[str] = None
+        self.start_time: Optional[float] = None
+        self.end_time: Optional[float] = None
+
+
+class ResourceManager:
+    """Run experiments over ``num_slots`` executor slots.
+
+    ``run_fn(config) -> result_dict | None`` executes one experiment (the
+    autotuner's ``run_trial``); exceptions / None mark the experiment
+    FAILED. With one slot this is the single-host serial flow; more slots
+    round-robin (a pod-slice pool would pass per-slice executors)."""
+
+    def __init__(self, run_fn: Callable[[Dict], Optional[Dict]], num_slots: int = 1):
+        self.run_fn = run_fn
+        self.num_slots = max(1, num_slots)
+        self.experiments: List[Experiment] = []
+
+    def schedule(self, config: Dict) -> Experiment:
+        exp = Experiment(config)
+        self.experiments.append(exp)
+        return exp
+
+    def schedule_all(self, configs: List[Dict]) -> List[Experiment]:
+        return [self.schedule(c) for c in configs]
+
+    def _run_one(self, exp: Experiment) -> None:
+        exp.status = ExpStatus.RUNNING
+        exp.start_time = time.perf_counter()
+        try:
+            result = self.run_fn(exp.config)
+        except Exception as e:  # an exploding trial must not kill the sweep
+            exp.status = ExpStatus.FAILED
+            exp.error = f"{type(e).__name__}: {e}"
+            exp.end_time = time.perf_counter()
+            return
+        exp.end_time = time.perf_counter()
+        if result is None:
+            exp.status = ExpStatus.FAILED
+        else:
+            exp.status = ExpStatus.DONE
+            exp.result = result
+
+    def run(self) -> List[Experiment]:
+        """Drain the queue. With >1 slot, experiments run concurrently in a
+        thread pool (each slot's executor owns its device resources)."""
+        queued = [e for e in self.experiments if e.status == ExpStatus.QUEUED]
+        if self.num_slots == 1:
+            for exp in queued:
+                self._run_one(exp)
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=self.num_slots) as pool:
+                list(pool.map(self._run_one, queued))
+        return self.experiments
+
+    # --- reporting -------------------------------------------------------
+    def finished(self) -> List[Experiment]:
+        return [e for e in self.experiments if e.status in (ExpStatus.DONE, ExpStatus.FAILED)]
+
+    def successful(self) -> List[Experiment]:
+        return [e for e in self.experiments if e.status == ExpStatus.DONE]
+
+    def best(self, key: Callable[[Dict], Any], maximize: bool = True) -> Optional[Experiment]:
+        done = self.successful()
+        if not done:
+            return None
+        pick = max if maximize else min
+        return pick(done, key=lambda e: key(e.result))
+
+    def summary(self) -> List[Dict]:
+        return [
+            {
+                "exp_id": e.exp_id,
+                "status": e.status.value,
+                "stage": e.config.get("zero_optimization", {}).get("stage"),
+                "micro_batch": e.config.get("train_micro_batch_size_per_gpu"),
+                "result": e.result,
+                "error": e.error,
+                "elapsed_s": (
+                    (e.end_time - e.start_time)
+                    if e.start_time is not None and e.end_time is not None
+                    else None
+                ),
+            }
+            for e in self.experiments
+        ]

@@ -74,3 +74,74 @@ class TestAutotuner:
         assert best is not None
         assert best["throughput_samples_per_s"] > 0
         assert best["config"]["zero_optimization"]["stage"] in (0, 1)
+
+
+class TestConfigTemplates:
+    def test_templates_per_stage(self):
+        from deepspeed_tpu.autotuning import STAGE_TEMPLATES, template_for_stage
+
+        assert set(STAGE_TEMPLATES) == {0, 1, 2, 3}
+        t3 = template_for_stage(3)
+        assert t3["zero_optimization"]["overlap_comm"] is True
+        t3["zero_optimization"]["stage"] = 99  # copies, not shared state
+        assert STAGE_TEMPLATES[3]["zero_optimization"]["stage"] == 3
+
+    def test_user_values_win_over_template(self):
+        from deepspeed_tpu.autotuning import candidate_configs
+
+        base = {
+            "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"reduce_bucket_size": 123},
+        }
+        cfgs = candidate_configs(base, stages=[2], micro_batches=[1, 4])
+        assert len(cfgs) == 2
+        for cfg in cfgs:
+            assert cfg["zero_optimization"]["stage"] == 2
+            assert cfg["zero_optimization"]["reduce_bucket_size"] == 123  # user wins
+            assert cfg["zero_optimization"]["reduce_scatter"] is True  # template fills
+            assert cfg["optimizer"]["params"]["lr"] == 1e-3
+        assert [c["train_micro_batch_size_per_gpu"] for c in cfgs] == [1, 4]
+
+
+class TestResourceManager:
+    def test_schedules_and_tracks_status(self):
+        from deepspeed_tpu.autotuning import ExpStatus, ResourceManager
+
+        def run_fn(cfg):
+            if cfg.get("boom"):
+                raise RuntimeError("exploded")
+            if cfg.get("none"):
+                return None
+            return {"throughput": cfg["id"] * 10}
+
+        rm = ResourceManager(run_fn)
+        rm.schedule_all([{"id": 1}, {"id": 3}, {"boom": True}, {"none": True}])
+        rm.run()
+        statuses = [e.status for e in rm.experiments]
+        assert statuses == [
+            ExpStatus.DONE,
+            ExpStatus.DONE,
+            ExpStatus.FAILED,
+            ExpStatus.FAILED,
+        ]
+        assert "exploded" in rm.experiments[2].error
+        best = rm.best(key=lambda r: r["throughput"])
+        assert best.config["id"] == 3
+        summary = rm.summary()
+        assert len(summary) == 4 and summary[0]["status"] == "done"
+
+    def test_multi_slot_pool(self):
+        from deepspeed_tpu.autotuning import ResourceManager
+
+        import threading
+
+        seen = set()
+
+        def run_fn(cfg):
+            seen.add(threading.get_ident())
+            return {"v": cfg["id"]}
+
+        rm = ResourceManager(run_fn, num_slots=3)
+        rm.schedule_all([{"id": i} for i in range(6)])
+        rm.run()
+        assert len(rm.successful()) == 6
