@@ -1,6 +1,7 @@
 """Compression (reference: ``deepspeed/compression/``)."""
 
 from deepspeed_tpu.compression.compress import (
+    CompressionScheduler,
     init_compression,
     redundancy_clean,
     student_initialization,

@@ -45,7 +45,8 @@ DIFFERENT_GROUPS = "different_groups"
 
 def _method_specs(compression_config: Dict) -> List[Tuple[str, Dict, List[str]]]:
     """Flatten the reference's nested config into
-    (method, params, module_patterns) rows."""
+    (method, params, module_patterns) rows. ``schedule_offset``(+``_end``)
+    ride along in params — the staging the compression scheduler drives."""
     rows = []
     for method in (WEIGHT_QUANTIZATION, SPARSE_PRUNING, ROW_PRUNING, HEAD_PRUNING, CHANNEL_PRUNING):
         block = compression_config.get(method)
@@ -60,6 +61,18 @@ def _method_specs(compression_config: Dict) -> List[Tuple[str, Dict, List[str]]]
             modules = group.get("modules", ["*"])
             rows.append((method, params, modules))
     return rows
+
+
+def _row_active(params: Dict, step: int) -> bool:
+    """A method group is live once training reaches its schedule_offset and
+    (when set) until schedule_offset_end (reference scheduler semantics)."""
+    start = int(params.get("schedule_offset", 0) or 0)
+    end = int(params.get("schedule_offset_end", 0) or 0)
+    if step < start:
+        return False
+    if end and step > end:
+        return False
+    return True
 
 
 def _pattern_to_regex(pat: str) -> str:
@@ -98,11 +111,22 @@ class CompressedModule(DSModule):
         self.inner = inner
         self.rows = _method_specs(compression_config)
         self.enabled_methods = {m for m, _, _ in self.rows}
+        # staging: methods activate at their schedule_offset; a step change
+        # that flips a row's activation retraces the jitted step once
+        self._step = 0
         logger.info(
             f"init_compression: {len(self.rows)} group(s), methods={sorted(self.enabled_methods)}"
         )
 
+    def set_step(self, step: int) -> None:
+        self._step = int(step)
+
+    def active_rows(self):
+        return [r for r in self.rows if _row_active(r[1], self._step)]
+
     def _compress(self, params):
+        rows = self.active_rows()
+
         def walk(prefix, tree):
             if isinstance(tree, dict):
                 return {k: walk(f"{prefix}/{k}" if prefix else k, v) for k, v in tree.items()}
@@ -111,7 +135,7 @@ class CompressedModule(DSModule):
             w = tree
             if jnp.ndim(w) < 2:
                 return w  # biases/norms stay exact (reference behavior)
-            for method, p, patterns in self.rows:
+            for method, p, patterns in rows:
                 if _matches(prefix, patterns):
                     w = _transform_leaf(method, p, w)
             return w
@@ -153,9 +177,74 @@ def redundancy_clean(params, deepspeed_config, mpu=None):  # noqa: ARG001
     return shim._compress(params)
 
 
-def student_initialization(student_params, teacher_params, deepspeed_config):  # noqa: ARG001
-    """(reference compress.py:192) Layer-reduction init: copy matching
-    teacher leaves into the student tree where shapes agree."""
+class CompressionScheduler:
+    """Drives the staging (reference ``compression_scheduler``): call
+    ``step(global_step)`` each optimizer step; the wrapped module's method
+    groups activate/deactivate per their schedule_offset windows."""
+
+    def __init__(self, module: "CompressedModule"):
+        if not isinstance(module, CompressedModule):
+            raise TypeError("CompressionScheduler wraps a CompressedModule")
+        self.module = module
+
+    def step(self, global_step: int) -> None:
+        self.module.set_step(global_step)
+
+    def active_methods(self):
+        return sorted({m for m, _, _ in self.module.active_rows()})
+
+
+def _get_by_path(tree, path: str):
+    node = tree
+    for part in path.split("."):
+        node = node[part]
+    return node
+
+
+def _set_by_path(tree, path: str, value):
+    parts = path.split(".")
+    node = tree
+    for part in parts[:-1]:
+        node = node[part]
+    node[parts[-1]] = value
+
+
+def student_initialization(student_params, teacher_params, deepspeed_config):
+    """(reference compress.py:192) Layer-reduction distillation init.
+
+    With ``compression_training.layer_reduction`` configured, the student's
+    stacked layer tree is built from the teacher's selected layers
+    (``teacher_layer``, e.g. [1,3,5,7] initializes a 4-layer student from
+    alternating teacher layers) and the subtrees named in
+    ``other_module_name`` (dot paths, e.g. "embed") copy over whole.
+    Without the config: shape-matched leaves copy (the generic warm start).
+    """
+    import numpy as np
+
+    cfg = deepspeed_config
+    if isinstance(cfg, dict):
+        cfg = cfg.get("compression_training", cfg)
+    lr_cfg = (cfg or {}).get(LAYER_REDUCTION, {})
+    if lr_cfg.get("enabled", False):
+        teacher_layer = list(lr_cfg["teacher_layer"])
+        prefix = lr_cfg.get("module_name_prefix", "layers")
+        others = lr_cfg.get("other_module_name", [])
+        out = jax.tree_util.tree_map(lambda s: s, student_params)  # copy structure
+        t_layers = _get_by_path(teacher_params, prefix)
+        s_layers = _get_by_path(student_params, prefix)
+        n_student = jax.tree_util.tree_leaves(s_layers)[0].shape[0]
+        if len(teacher_layer) != n_student:
+            raise ValueError(
+                f"teacher_layer selects {len(teacher_layer)} layers but the "
+                f"student has {n_student}"
+            )
+        sel = np.asarray(teacher_layer)
+        _set_by_path(
+            out, prefix, jax.tree_util.tree_map(lambda a: jnp.asarray(a)[sel], t_layers)
+        )
+        for name in others:
+            _set_by_path(out, name, _get_by_path(teacher_params, name))
+        return out
 
     def walk(s, t):
         if isinstance(s, dict):

@@ -130,3 +130,100 @@ class TestProgressiveLayerDrop:
         pld.update_state(10**6)
         assert abs(pld.get_theta() - 0.5) < 1e-6
         assert pld.get_state()["progressive_layer_drop"]
+
+
+class TestStaging:
+    def _module(self, offset=5, end=0):
+        from deepspeed_tpu.compression import CompressionScheduler, init_compression
+        from tests.unit.simple_model import SimpleModel
+
+        cfg = {
+            "compression_training": {
+                "weight_quantization": {
+                    "shared_parameters": {
+                        "enabled": True,
+                        "schedule_offset": offset,
+                        **({"schedule_offset_end": end} if end else {}),
+                    },
+                    "different_groups": {
+                        "wq1": {"params": {"start_bits": 8}, "modules": ["*"]}
+                    },
+                }
+            }
+        }
+        module = init_compression(SimpleModel(8), cfg)
+        return module, CompressionScheduler(module)
+
+    def test_method_activates_at_offset(self):
+        module, sched = self._module(offset=5)
+        sched.step(0)
+        assert sched.active_methods() == []
+        sched.step(5)
+        assert sched.active_methods() == ["weight_quantization"]
+
+    def test_method_deactivates_after_end(self):
+        module, sched = self._module(offset=2, end=4)
+        sched.step(3)
+        assert sched.active_methods() == ["weight_quantization"]
+        sched.step(5)
+        assert sched.active_methods() == []
+
+    def test_inactive_stage_is_identity(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        module, sched = self._module(offset=100)
+        w = {"w0": jnp.asarray(np.random.RandomState(0).randn(8, 8), jnp.float32)}
+        sched.step(0)
+        np.testing.assert_array_equal(np.asarray(module._compress(w)["w0"]), np.asarray(w["w0"]))
+        sched.step(100)
+        assert not np.array_equal(np.asarray(module._compress(w)["w0"]), np.asarray(w["w0"]))
+
+
+class TestLayerReductionDistillation:
+    def test_student_from_teacher_layers(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from deepspeed_tpu.compression import student_initialization
+
+        rs = np.random.RandomState(0)
+        teacher = {
+            "embed": {"tokens": jnp.asarray(rs.randn(16, 4), jnp.float32)},
+            "layers": {"w": jnp.asarray(rs.randn(8, 4, 4), jnp.float32)},
+            "head": jnp.asarray(rs.randn(4, 16), jnp.float32),
+        }
+        student = {
+            "embed": {"tokens": jnp.zeros((16, 4))},
+            "layers": {"w": jnp.zeros((4, 4, 4))},
+            "head": jnp.zeros((4, 16)),
+        }
+        cfg = {
+            "compression_training": {
+                "layer_reduction": {
+                    "enabled": True,
+                    "teacher_layer": [1, 3, 5, 7],
+                    "module_name_prefix": "layers",
+                    "other_module_name": ["embed", "head"],
+                }
+            }
+        }
+        out = student_initialization(student, teacher, cfg)
+        np.testing.assert_array_equal(
+            np.asarray(out["layers"]["w"]), np.asarray(teacher["layers"]["w"])[[1, 3, 5, 7]]
+        )
+        np.testing.assert_array_equal(np.asarray(out["embed"]["tokens"]), np.asarray(teacher["embed"]["tokens"]))
+        np.testing.assert_array_equal(np.asarray(out["head"]), np.asarray(teacher["head"]))
+
+    def test_mismatched_selection_raises(self):
+        import jax.numpy as jnp
+        import pytest
+
+        from deepspeed_tpu.compression import student_initialization
+
+        teacher = {"layers": {"w": jnp.zeros((8, 4))}}
+        student = {"layers": {"w": jnp.zeros((4, 4))}}
+        cfg = {"layer_reduction": {"enabled": True, "teacher_layer": [0, 2, 4]}}
+        with pytest.raises(ValueError, match="teacher_layer"):
+            student_initialization(student, teacher, cfg)
