@@ -75,18 +75,30 @@ def _layer_mlp(cfg: TransformerConfig, p, x):
     return x + apply_dense_ffn(p, h, cfg.activation)
 
 
-def _cached_attention(cfg, q, k_cache, v_cache, q_positions, kv_len_mask):
+def _cached_attention(cfg, q, k_cache, v_cache, q_positions, kv_len_mask, kv_len=None):
     """q [B,T,NH,D] against the full cache [B,S,NKV,D]; positions beyond the
     valid length are masked (the reference softmax_context semantics)."""
     NH, NKV = q.shape[2], k_cache.shape[2]
-    if NKV != NH:
-        k_cache = jnp.repeat(k_cache, NH // NKV, axis=2)
-        v_cache = jnp.repeat(v_cache, NH // NKV, axis=2)
     scale = (
         cfg.attn_softmax_scale
         if getattr(cfg, "attn_softmax_scale", None) is not None
         else 1.0 / np.sqrt(q.shape[-1])
     )
+    if (
+        q.shape[1] == 1
+        and kv_len is not None
+        and cfg.position != "alibi"
+        and k_cache.shape[1] % 256 == 0
+    ):
+        # single-token decode: the fused ragged kernel reads only live cache
+        # blocks (and GQA kv rows once, without the repeat below)
+        from deepspeed_tpu.ops.transformer.decode_attention import decode_attention
+
+        out = decode_attention(q[:, 0], k_cache, v_cache, kv_len, scale=scale)
+        return out[:, None]
+    if NKV != NH:
+        k_cache = jnp.repeat(k_cache, NH // NKV, axis=2)
+        v_cache = jnp.repeat(v_cache, NH // NKV, axis=2)
     scores = jnp.einsum("btnd,bsnd->bnts", q, k_cache).astype(jnp.float32) * scale
     S = k_cache.shape[1]
     kv_pos = jnp.arange(S, dtype=jnp.int32)
@@ -125,7 +137,9 @@ def _forward_with_cache(cfg, params, tokens, cache: KVCache, start_pos):
         v_cache_l = jax.lax.dynamic_update_slice(
             v_cache_l, v_new.astype(v_cache_l.dtype), (0, start_pos, 0, 0)
         )
-        attn = _cached_attention(cfg, q, k_cache_l, v_cache_l, positions_b, kv_len_mask)
+        attn = _cached_attention(
+            cfg, q, k_cache_l, v_cache_l, positions_b, kv_len_mask, kv_len=start_pos + T
+        )
         attn = attn.reshape(B, T, cfg.num_heads * cfg.head_dim) @ p["wo"].astype(x.dtype)
         if cfg.use_bias:
             attn = attn + p["bo"].astype(x.dtype)

@@ -1,0 +1,148 @@
+"""Pallas ragged KV-cache decode attention (TPU).
+
+Counterpart of the reference's fused ``softmax_context`` decode kernel
+(``csrc/transformer/inference/csrc/softmax.cu`` +
+``pt_binding.cpp:1935-1974``): one generated token attends over the live
+prefix of a preallocated KV cache.
+
+Shape strategy: the single query token's HEADS ride the sublane dim — the
+per-block score matmul is [NH, D] x [D, blk] on the MXU — and the kv grid
+dimension walks cache blocks with online softmax, skipping blocks past the
+row's live length entirely (``pl.when``): HBM reads scale with kv_len, not
+cache capacity. Per-batch lengths arrive via scalar prefetch, making the
+kernel ragged — each batch row stops at its own length (the paged/ragged
+attention the reference approximates with masking).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_s, l_s, acc_s, *, scale, blk, nk):
+    b = pl.program_id(0)
+    ki = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_s[...] = jnp.full_like(m_s, NEG_INF)
+        l_s[...] = jnp.zeros_like(l_s)
+        acc_s[...] = jnp.zeros_like(acc_s)
+
+    @pl.when(ki * blk < len_ref[b])
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)  # [NH, D]
+        k = k_ref[0].astype(jnp.float32)  # [blk, D]
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [NH, blk]
+        pos = ki * blk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(pos < len_ref[b], s, NEG_INF)
+        m_prev = m_s[:, :1]
+        l_prev = l_s[:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = corr * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        acc_s[...] = acc_s[...] * corr + jax.lax.dot(p, v, preferred_element_type=jnp.float32)
+        m_s[...] = jnp.broadcast_to(m_new, m_s.shape)
+        l_s[...] = jnp.broadcast_to(l_new, l_s.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = l_s[:, :1]
+        safe_l = jnp.where(l == 0, 1.0, l)
+        o_ref[0] = (acc_s[...] / safe_l).astype(o_ref.dtype)
+
+
+def decode_attention(
+    q: jnp.ndarray,  # [B, NH, D] — the current token's queries
+    k_cache: jnp.ndarray,  # [B, S, NKV, D] — NO GQA pre-expansion needed
+    v_cache: jnp.ndarray,
+    kv_len,  # [B] int32 live lengths (ragged) or a scalar
+    scale: Optional[float] = None,
+    block_k: int = 256,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Fused single-token attention over each row's live cache prefix.
+
+    Heads grouped per kv head: each grid row (batch, kv-head) computes
+    [NH/NKV, D] x [D, blk] — GQA's shared kv rows are read once, not
+    repeated NH/NKV times like the dense fallback's jnp.repeat."""
+    B, NH, D = q.shape
+    S, NKV = k_cache.shape[1], k_cache.shape[2]
+    assert k_cache.shape == v_cache.shape == (B, S, NKV, D)
+    if NH % NKV:
+        raise ValueError(f"query heads {NH} not a multiple of kv heads {NKV}")
+    scale_f = float(scale) if scale is not None else 1.0 / float(np.sqrt(D))
+    if interpret is None:
+        interpret = not _on_tpu()
+    blk = min(block_k, S)
+    if S % blk:
+        raise ValueError(f"cache capacity {S} not divisible by block_k {blk}")
+    nk = S // blk
+    lens = jnp.broadcast_to(jnp.asarray(kv_len, jnp.int32), (B,))
+    return _grouped_decode(q, k_cache, v_cache, lens, scale_f, blk, nk, interpret)
+
+
+def _grouped_decode(q, k_cache, v_cache, lens, scale_f, blk, nk, interpret):
+    """Group heads by shared kv rows. With the cache stored per kv head and
+    queries pre-grouped [B, G, Hg, D] (Hg = heads per kv head), each grid
+    row (b, g) computes [Hg, D] x [D, blk] — for MHA Hg=1 folds into BN
+    rows; for GQA the group's heads batch into the sublane dim."""
+    B, NH, D = q.shape
+    S = k_cache.shape[1]
+    NKV = k_cache.shape[2]
+    Hg = NH // NKV
+    # q: [B, NKV, Hg, D] rows; kv: [B, NKV, S, D]
+    qg = q.reshape(B, NKV, Hg, D).reshape(B * NKV, Hg, D)
+    kg = k_cache.transpose(0, 2, 1, 3).reshape(B * NKV, S, D)
+    vg = v_cache.transpose(0, 2, 1, 3).reshape(B * NKV, S, D)
+    lens_g = jnp.repeat(lens, NKV)
+    kernel = functools.partial(_decode_kernel, scale=scale_f, blk=blk, nk=nk)
+    params = {}
+    if not interpret:
+        params["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")
+        )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B * NKV, nk),
+        in_specs=[
+            pl.BlockSpec((1, Hg, D), lambda b, ki, lens_ref: (b, 0, 0)),
+            pl.BlockSpec((1, blk, D), lambda b, ki, lens_ref: (b, ki, 0)),
+            pl.BlockSpec((1, blk, D), lambda b, ki, lens_ref: (b, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, Hg, D), lambda b, ki, lens_ref: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((Hg, 128), jnp.float32),
+            pltpu.VMEM((Hg, 128), jnp.float32),
+            pltpu.VMEM((Hg, D), jnp.float32),
+        ],
+    )
+    o = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B * NKV, Hg, D), q.dtype),
+        interpret=interpret,
+        **params,
+    )(lens_g, qg, kg, vg)
+    return o.reshape(B, NKV, Hg, D).reshape(B, NH, D)

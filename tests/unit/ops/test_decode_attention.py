@@ -1,0 +1,75 @@
+"""Ragged decode-attention kernel tests (interpret mode on CPU).
+
+Reference analog: ``tests/unit/ops/transformer/inference`` softmax_context
+numerics — the fused single-token cache attention must match the dense
+masked computation, including ragged per-batch lengths and GQA grouping.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.transformer.decode_attention import decode_attention
+
+
+def _dense_ref(q, k_cache, v_cache, kv_len, scale):
+    B, NH, D = q.shape
+    S, NKV = k_cache.shape[1], k_cache.shape[2]
+    if NKV != NH:
+        k_cache = np.repeat(k_cache, NH // NKV, axis=2)
+        v_cache = np.repeat(v_cache, NH // NKV, axis=2)
+    scores = np.einsum("bnd,bsnd->bns", q, k_cache).astype(np.float64) * scale
+    lens = np.broadcast_to(np.asarray(kv_len), (B,))
+    for b in range(B):
+        scores[b, :, lens[b] :] = -1e30
+    probs = np.exp(scores - scores.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    return np.einsum("bns,bsnd->bnd", probs, v_cache)
+
+
+@pytest.mark.parametrize("nkv", [8, 2])  # MHA and GQA grouping
+def test_matches_dense(nkv):
+    B, NH, D, S = 3, 8, 64, 512
+    rs = np.random.RandomState(0)
+    q = rs.randn(B, NH, D).astype(np.float32)
+    k = rs.randn(B, S, nkv, D).astype(np.float32)
+    v = rs.randn(B, S, nkv, D).astype(np.float32)
+    lens = np.array([1, 200, 512], np.int32)  # ragged, incl. edges
+    out = decode_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), lens, block_k=128)
+    ref = _dense_ref(q, k, v, lens, 1.0 / np.sqrt(D))
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5, atol=2e-5)
+
+
+def test_scalar_length_and_custom_scale():
+    B, NH, D, S = 2, 4, 32, 256
+    rs = np.random.RandomState(1)
+    q = rs.randn(B, NH, D).astype(np.float32)
+    k = rs.randn(B, S, NH, D).astype(np.float32)
+    v = rs.randn(B, S, NH, D).astype(np.float32)
+    out = decode_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), 77, scale=1.0)
+    ref = _dense_ref(q, k, v, 77, 1.0)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5, atol=2e-5)
+
+
+def test_dead_blocks_are_skipped_semantics():
+    """Values in cache slots past kv_len must not affect the output."""
+    B, NH, D, S = 1, 4, 32, 512
+    rs = np.random.RandomState(2)
+    q = rs.randn(B, NH, D).astype(np.float32)
+    k = rs.randn(B, S, NH, D).astype(np.float32)
+    v = rs.randn(B, S, NH, D).astype(np.float32)
+    out1 = decode_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), 100, block_k=128)
+    k2, v2 = k.copy(), v.copy()
+    k2[:, 100:] = 1e6  # garbage beyond the live prefix
+    v2[:, 100:] = -1e6
+    out2 = decode_attention(jnp.asarray(q), jnp.asarray(k2), jnp.asarray(v2), 100, block_k=128)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2), rtol=1e-6)
+
+
+def test_rejects_bad_shapes():
+    q = jnp.zeros((1, 6, 8))
+    kv = jnp.zeros((1, 256, 4, 8))
+    with pytest.raises(ValueError, match="multiple"):
+        decode_attention(q, kv, kv, 10)
