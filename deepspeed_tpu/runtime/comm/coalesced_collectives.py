@@ -147,3 +147,10 @@ def quantized_all_gather(
         body, mesh=mesh, in_specs=P(axis_name), out_specs=P(), check_vma=False
     )(shard.reshape(shard.shape[0], -1))
     return out.reshape((-1,) + shard.shape[1:])
+
+
+def all_to_all_quant_reduce(tensors, mesh: Mesh, axis_name: str = "data", **kw):
+    """Reference-named entry (``coalesced_collectives.py:31``): quantized
+    grad reduce over a tensor list; each result is the caller's summed
+    shard."""
+    return [quantized_reduce_scatter(t, mesh, axis_name, **kw) for t in tensors]
