@@ -175,3 +175,39 @@ from deepspeed_tpu import zero  # noqa: E402
 from deepspeed_tpu.runtime import lr_schedules  # noqa: E402
 from deepspeed_tpu.pipe import PipelineModule  # noqa: E402
 from deepspeed_tpu.runtime.module import DSModule  # noqa: E402
+from deepspeed_tpu.ops.transformer.transformer import (  # noqa: E402
+    DeepSpeedTransformerConfig,
+    DeepSpeedTransformerLayer,
+)
+
+
+class OnDevice:
+    """Construction-placement context (reference ``deepspeed/__init__.py:37``
+    ``OnDevice``: meta-device model building). Functional init makes this a
+    placement hint: inside the context, ``jax.default_device`` points at the
+    requested device ('meta' maps to abstract shapes — build with
+    ``jax.eval_shape`` for a true zero-memory init)."""
+
+    def __init__(self, dtype=None, device: str = "", enabled: bool = True):
+        self.dtype = dtype
+        self.device = device
+        self.enabled = enabled
+        self._ctx = None
+
+    def __enter__(self):
+        if not self.enabled or self.device in ("", "meta"):
+            return self
+        import jax
+
+        kind = self.device.split(":")[0]
+        devs = [d for d in jax.devices() if kind in (d.platform, str(d))]
+        if devs:
+            self._ctx = jax.default_device(devs[0])
+            self._ctx.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        if self._ctx is not None:
+            self._ctx.__exit__(*exc)
+            self._ctx = None
+        return False
