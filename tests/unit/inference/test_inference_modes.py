@@ -117,3 +117,28 @@ class TestZeroInference:
         out = np.asarray(engine.generate(toks, max_new_tokens=4))
         assert out.shape == (8, 8)
         np.testing.assert_array_equal(out[:, :4], toks)
+
+
+class TestWeightQuantInference:
+    def test_quant_flag_changes_numerics_within_tolerance(self, eight_devices):
+        mesh_mod.reset_topology()
+        cfg_m = dict(
+            vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+            dtype="float32", flash_attention=False,
+        )
+        toks = np.random.RandomState(0).randint(0, 64, (8, 8)).astype(np.int32)
+
+        plain = ds.init_inference(TransformerLM(TransformerConfig(**cfg_m)), dtype="fp32")
+        plain.init_params(toks)
+        base = np.asarray(plain(toks))
+
+        mesh_mod.reset_topology()
+        quant = ds.init_inference(
+            TransformerLM(TransformerConfig(**cfg_m)),
+            dtype="fp32",
+            quant={"enabled": True, "num_bits": 8, "group_size": 32},
+        )
+        quant.init_params(toks)
+        q_out = np.asarray(quant(toks))
+        assert not np.array_equal(q_out, base), "quant flag was silently ignored"
+        np.testing.assert_allclose(q_out, base, rtol=0.2, atol=0.5)
