@@ -1389,6 +1389,42 @@ class DeepSpeedEngine:
         client_state = state.get("client_state", {})
         return path, client_state
 
+    def consolidated_16bit_state_dict(self) -> Dict[str, Any]:
+        """Full compute-dtype weights as a flat host dict (reference
+        ``_zero3_consolidated_16bit_state_dict``, engine.py:3373 — the
+        all-gather the reference choreographs rank-by-rank is a device_get
+        of global arrays here)."""
+        from deepspeed_tpu.utils.tensor_fragment import _flatten_with_paths
+
+        params = self.get_params()
+        return {
+            name: np.asarray(jax.device_get(leaf))
+            for name, leaf in _flatten_with_paths(params).items()
+        }
+
+    def save_16bit_model(self, save_dir: str, save_filename: str = "pytorch_model.bin", exclude_frozen_parameters: bool = False):  # noqa: ARG002
+        """Write ONE consolidated compute-dtype weights file loadable without
+        the engine (reference ``save_16bit_model``, engine.py:3442).
+        ``.bin`` filenames save a torch state dict (torch interop); anything
+        else saves an ``npz`` with the same flat names."""
+        if not self._initialized:
+            raise RuntimeError("cannot save before the engine state is initialized")
+        sd = self.consolidated_16bit_state_dict()
+        os.makedirs(save_dir, exist_ok=True)
+        path = os.path.join(save_dir, save_filename)
+        if dist.get_rank() == 0:
+            if save_filename.endswith((".bin", ".pt")):
+                import torch
+
+                torch.save(
+                    {k: torch.from_numpy(np.ascontiguousarray(v.astype(np.float32))) for k, v in sd.items()},
+                    path,
+                )
+            else:
+                np.savez(path, **sd)
+        dist.barrier(name="save_16bit_model")
+        return True
+
     # ------------------------------------------------------------------
     # introspection / utils
     # ------------------------------------------------------------------

@@ -189,3 +189,40 @@ class TestDSElasticAgent:
     def test_requires_elasticity_enabled(self):
         with pytest.raises(ValueError, match="elasticity"):
             DSElasticAgent(WorkerSpec(["x"]), {"elasticity": {"enabled": False}})
+
+
+class TestSave16BitModel:
+    def test_consolidated_save(self, tmp_path, eight_devices):
+        import deepspeed_tpu as ds
+        import deepspeed_tpu.parallel.mesh as mesh_mod
+        from tests.unit.simple_model import SimpleModel, random_dataloader
+
+        mesh_mod.reset_topology()
+        engine, *_ = ds.initialize(
+            model=SimpleModel(32),
+            config={
+                "train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+                "bf16": {"enabled": True},
+                "zero_optimization": {"stage": 3, "stage3_param_persistence_threshold": 0},
+            },
+        )
+        batch = next(random_dataloader(32, total_samples=8, batch_size=8))
+        loss = engine(batch)
+        engine.backward(loss)
+        engine.step()
+
+        engine.save_16bit_model(str(tmp_path), "model.bin")
+        import torch
+
+        sd = torch.load(str(tmp_path / "model.bin"), weights_only=True)
+        assert "w0" in sd
+        np.testing.assert_allclose(
+            sd["w0"].numpy(),
+            np.asarray(engine.get_params()["w0"], dtype=np.float32),
+            rtol=1e-6,
+        )
+
+        engine.save_16bit_model(str(tmp_path), "model.npz")
+        loaded = np.load(str(tmp_path / "model.npz"))
+        assert "w0" in loaded
