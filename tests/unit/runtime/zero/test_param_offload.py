@@ -298,3 +298,35 @@ class TestParamOffloadNvme:
         gathered = engine.get_params()["layers"]
         leaf = next(iter(gathered.values()))
         assert not np.array_equal(leaf[0], leaf[2]), "staging-buffer aliasing"
+
+
+class TestParamOffloadFp16:
+    def test_overflow_skip_and_rescale(self):
+        """fp16 + dynamic loss scale on the stream path: early steps overflow
+        at the huge initial scale, get skipped (reference overflow-skip
+        semantics), the scale backs off, training proceeds."""
+        mesh_mod.reset_topology()
+        cfg_m = dict(CFG, dtype="float16")
+        model = TransformerLM(TransformerConfig(**cfg_m))
+        engine, _, _, _ = ds.initialize(
+            model=model,
+            config=dict(
+                BASE,
+                fp16={"enabled": True, "initial_scale_power": 20},
+                zero_optimization={"stage": 3, "offload_param": {"device": "cpu"}},
+            ),
+            dist_init_required=False,
+        )
+        scales = []
+        losses = []
+        for batch in _batches(8, 8):
+            loss = engine(batch)
+            engine.backward(loss)
+            engine.step()
+            losses.append(float(loss))
+            scales.append(engine.loss_scale)
+        assert engine.skipped_steps > 0, "expected early overflow skips at 2^20"
+        assert scales[-1] < scales[0], "dynamic scale never backed off"
+        assert np.isfinite(losses[-1])
+        # parameters only moved on non-skipped steps
+        assert engine.global_steps == 8
