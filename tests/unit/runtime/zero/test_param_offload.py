@@ -326,7 +326,27 @@ class TestParamOffloadFp16:
             losses.append(float(loss))
             scales.append(engine.loss_scale)
         assert engine.skipped_steps > 0, "expected early overflow skips at 2^20"
+        assert engine.skipped_steps < 8, "every step skipped: scale never recovered"
         assert scales[-1] < scales[0], "dynamic scale never backed off"
         assert np.isfinite(losses[-1])
-        # parameters only moved on non-skipped steps
+        # weights moved (some step applied) but the step counter counts all
+        init_master = np.concatenate(
+            [st.master for st in engine._param_stream._layer_state]
+        )
+        mesh_mod.reset_topology()
+        fresh = TransformerLM(TransformerConfig(**cfg_m))
+        e2, _, _, _ = ds.initialize(
+            model=fresh,
+            config=dict(
+                BASE,
+                fp16={"enabled": True, "initial_scale_power": 20},
+                zero_optimization={"stage": 3, "offload_param": {"device": "cpu"}},
+            ),
+            dist_init_required=False,
+        )
+        e2.init_params(_batches(8, 1)[0])
+        fresh_master = np.concatenate(
+            [st.master for st in e2._param_stream._layer_state]
+        )
+        assert not np.array_equal(init_master, fresh_master), "no step ever applied"
         assert engine.global_steps == 8
