@@ -2,6 +2,7 @@ from deepspeed_tpu.models.config import TransformerConfig, bert_config, gpt2_con
 from deepspeed_tpu.models.moe_transformer import (
     MoETransformerConfig,
     MoETransformerLM,
+    mixtral_config,
     moe_llama_config,
 )
 from deepspeed_tpu.models.transformer import TransformerLM, cross_entropy_loss

@@ -189,3 +189,34 @@ def moe_llama_config(size: str = "tiny", **overrides) -> MoETransformerConfig:
     base.update(presets[size])
     base.update(overrides)
     return MoETransformerConfig(**base)
+
+
+def mixtral_config(size: str = "8x7b", **overrides) -> MoETransformerConfig:
+    """Mixtral presets (BASELINE config 5's model family): GQA llama body,
+    8 experts, top-2 routing, every layer MoE."""
+    presets = {
+        "tiny": dict(hidden_size=256, num_layers=4, num_heads=8, num_kv_heads=2, vocab_size=32000, max_seq_len=512),
+        "8x7b": dict(
+            hidden_size=4096,
+            num_layers=32,
+            num_heads=32,
+            num_kv_heads=8,
+            intermediate_size=14336,
+            vocab_size=32000,
+            max_seq_len=32768,
+        ),
+    }
+    base = dict(
+        norm="rmsnorm",
+        position="rope",
+        rope_theta=1e6,
+        activation="swiglu",
+        use_bias=False,
+        tie_embeddings=False,
+        num_experts=8,
+        moe_top_k=2,
+        moe_layer_freq=1,
+    )
+    base.update(presets[size])
+    base.update(overrides)
+    return MoETransformerConfig(**base)

@@ -199,3 +199,36 @@ class TestMoEEngine:
         # freq=2, exactly one layer is dense → dense_mlp stacks have L=1
         assert engine._params["dense_mlp"]["w_gate"].shape[0] == 1
         assert "w_gate" not in engine._params["layers"]
+
+
+def test_mixtral_preset_trains(eight_devices):
+    """Mixtral family (BASELINE config 5): tiny preset, top-2 routing,
+    expert-parallel mesh."""
+    import deepspeed_tpu as ds
+    import deepspeed_tpu.parallel.mesh as mesh_mod
+    from deepspeed_tpu.models import MoETransformerLM, mixtral_config
+
+    mesh_mod.reset_topology()
+    cfg = mixtral_config("tiny", num_layers=2, max_seq_len=64, dtype="float32", flash_attention=False)
+    assert cfg.moe_top_k == 2 and cfg.num_experts == 8
+    engine, *_ = ds.initialize(
+        model=MoETransformerLM(cfg),
+        config={
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 2},
+            "mesh": {"expert": 2, "data": 4},
+        },
+    )
+    import numpy as np
+
+    rs = np.random.RandomState(0)
+    losses = []
+    for _ in range(3):
+        toks = rs.randint(0, cfg.vocab_size, (8, 16)).astype(np.int32)
+        loss = engine({"input_ids": toks, "labels": toks})
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    assert np.isfinite(losses).all() if hasattr(np, "isfinite") else True
+    assert losses[-1] < losses[0]
