@@ -98,3 +98,31 @@ class DeepSpeedDataSampler:
 
     def load_state_dict(self, sd):
         self.consumed_samples = sd["consumed_samples"]
+
+
+def sampler_from_analysis(
+    save_path: str,
+    metric_name: str,
+    curriculum_scheduler,
+    num_replicas: int = 1,
+    rank: int = 0,
+    seed: int = 0,
+    global_batch_size: int = 1,
+) -> DeepSpeedDataSampler:
+    """Build the curriculum sampler from a ``DataAnalyzer`` run's
+    ``sample_to_metric`` table — the map-reduce → sampler hookup the
+    reference wires through its index files."""
+    from deepspeed_tpu.runtime.data_pipeline.data_sampling.data_analyzer import (
+        DataAnalyzer,
+    )
+
+    analyzer = DataAnalyzer([], metric_names=[], metric_functions=[], metric_types=[], save_path=save_path)
+    difficulties = analyzer.load_sample_to_metric(metric_name)
+    return DeepSpeedDataSampler(
+        difficulties,
+        curriculum_scheduler,
+        num_replicas=num_replicas,
+        rank=rank,
+        seed=seed,
+        global_batch_size=global_batch_size,
+    )

@@ -132,3 +132,40 @@ class TestDataAnalyzer:
                 metric_types=["bogus"],
                 save_path=str(tmp_path),
             )
+
+
+def test_sampler_from_analysis(tmp_path):
+    """Analyzer output feeds the curriculum sampler: early batches draw only
+    easy (short) samples."""
+    from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import (
+        CurriculumScheduler,
+    )
+    from deepspeed_tpu.runtime.data_pipeline.data_sampler import sampler_from_analysis
+
+    rs = np.random.RandomState(0)
+    data = [rs.randint(0, 50, size=n) for n in ([2] * 10 + [9] * 10)]
+    DataAnalyzer(
+        data,
+        metric_names=["seqlen"],
+        metric_functions=[len],
+        metric_types=["single_value_per_sample"],
+        save_path=str(tmp_path),
+    ).run()
+
+    sched = CurriculumScheduler(
+        {
+            "enabled": True,
+            "curriculum_type": "seqlen",
+            "min_difficulty": 2,
+            "max_difficulty": 9,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 100, "difficulty_step": 1},
+        }
+    )
+    sampler = sampler_from_analysis(
+        str(tmp_path), "seqlen", sched, global_batch_size=4
+    )
+    it = iter(sampler)
+    first_batch = [next(it) for _ in range(4)]
+    # at step 0 the threshold is min_difficulty=2: only the short samples
+    assert all(i < 10 for i in first_batch), first_batch
