@@ -324,30 +324,27 @@ def bench_moe_inference():
     }
 
 
+def _run_one(fn):
+    try:
+        return fn()
+    except Exception as e:  # one failed config must not kill the bench
+        traceback.print_exc()
+        return {
+            "metric": fn.__name__,
+            "value": 0,
+            "unit": f"error: {type(e).__name__}: {str(e)[:160]}",
+            "vs_baseline": 0,
+        }
+
+
 def main():
-    benches = [
-        bench_llama_zero3,
-        bench_infinity_max_params,
-        bench_long_seq,
-        bench_moe_inference,
-        bench_gpt2_zero1,  # headline LAST (driver parses the last JSON line)
-    ]
-    for fn in benches:
-        try:
-            print(json.dumps(fn()), flush=True)
-        except Exception as e:  # one failed config must not kill the bench
-            traceback.print_exc()
-            print(
-                json.dumps(
-                    {
-                        "metric": fn.__name__,
-                        "value": 0,
-                        "unit": f"error: {type(e).__name__}: {str(e)[:160]}",
-                        "vs_baseline": 0,
-                    }
-                ),
-                flush=True,
-            )
+    # headline FIRST (on record even if a later config hangs) and re-emitted
+    # LAST (the driver parses the final JSON line)
+    headline = _run_one(bench_gpt2_zero1)
+    print(json.dumps(headline), flush=True)
+    for fn in (bench_llama_zero3, bench_infinity_max_params, bench_long_seq, bench_moe_inference):
+        print(json.dumps(_run_one(fn)), flush=True)
+    print(json.dumps(headline), flush=True)
 
 
 if __name__ == "__main__":
