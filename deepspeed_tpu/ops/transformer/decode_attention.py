@@ -103,6 +103,102 @@ def decode_attention(
     return _grouped_decode(q, k_cache, v_cache, lens, scale_f, blk, nk, interpret)
 
 
+def _paged_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref, m_s, l_s, acc_s, *, scale, page, maxp):
+    b = pl.program_id(0)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_s[...] = jnp.full_like(m_s, NEG_INF)
+        l_s[...] = jnp.zeros_like(l_s)
+        acc_s[...] = jnp.zeros_like(acc_s)
+
+    @pl.when(ki * page < len_ref[b])
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)  # [Hg, D]
+        k = k_ref[0, 0].astype(jnp.float32)  # [page, D]
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        pos = ki * page + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(pos < len_ref[b], s, NEG_INF)
+        m_prev = m_s[:, :1]
+        l_prev = l_s[:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = corr * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        acc_s[...] = acc_s[...] * corr + jax.lax.dot(p, v, preferred_element_type=jnp.float32)
+        m_s[...] = jnp.broadcast_to(m_new, m_s.shape)
+        l_s[...] = jnp.broadcast_to(l_new, l_s.shape)
+
+    @pl.when(ki == maxp - 1)
+    def _finish():
+        l = l_s[:, :1]
+        safe_l = jnp.where(l == 0, 1.0, l)
+        o_ref[0, 0] = (acc_s[...] / safe_l).astype(o_ref.dtype)
+
+
+def paged_decode_attention(
+    q: jnp.ndarray,  # [B, NH, D]
+    k_pages: jnp.ndarray,  # [NP, NKV, P, D] — the shared page pool
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,  # [B, MAXP] int32 page ids per sequence
+    kv_len,  # [B] int32 live lengths
+    scale: Optional[float] = None,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Paged (block-table) decode attention — the vLLM-style serving layout
+    the reference approximates with contiguous per-sequence workspaces: each
+    sequence's cache is a list of pages in a shared pool, so prefixes can be
+    shared and memory allocates page-granular. The kernel's kv grid walks
+    the page table via scalar prefetch (k/v BlockSpecs jump straight to the
+    page), skipping table slots past the live length."""
+    B, NH, D = q.shape
+    NP, NKV, P, Dk = k_pages.shape
+    assert Dk == D and v_pages.shape == k_pages.shape
+    if NH % NKV:
+        raise ValueError(f"query heads {NH} not a multiple of kv heads {NKV}")
+    maxp = page_table.shape[1]
+    scale_f = float(scale) if scale is not None else 1.0 / float(np.sqrt(D))
+    if interpret is None:
+        interpret = not _on_tpu()
+    Hg = NH // NKV
+    qg = q.reshape(B, NKV, Hg, D)
+    lens = jnp.broadcast_to(jnp.asarray(kv_len, jnp.int32), (B,))
+    kernel = functools.partial(_paged_kernel, scale=scale_f, page=P, maxp=maxp)
+    params = {}
+    if not interpret:
+        params["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, NKV, maxp),
+        in_specs=[
+            pl.BlockSpec((1, 1, Hg, D), lambda b, g, ki, pt, ln: (b, g, 0, 0)),
+            pl.BlockSpec((1, 1, P, D), lambda b, g, ki, pt, ln: (pt[b, ki], g, 0, 0)),
+            pl.BlockSpec((1, 1, P, D), lambda b, g, ki, pt, ln: (pt[b, ki], g, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, Hg, D), lambda b, g, ki, pt, ln: (b, g, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((Hg, 128), jnp.float32),
+            pltpu.VMEM((Hg, 128), jnp.float32),
+            pltpu.VMEM((Hg, D), jnp.float32),
+        ],
+    )
+    o = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, NKV, Hg, D), q.dtype),
+        interpret=interpret,
+        **params,
+    )(jnp.asarray(page_table, jnp.int32), lens, qg, k_pages, v_pages)
+    return o.reshape(B, NH, D)
+
+
 def _grouped_decode(q, k_cache, v_cache, lens, scale_f, blk, nk, interpret):
     """Group heads by shared kv rows. With the cache stored per kv head and
     queries pre-grouped [B, G, Hg, D] (Hg = heads per kv head), each grid
