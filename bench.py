@@ -1,12 +1,27 @@
 """Benchmarks for the BASELINE target configs, one JSON line each.
 
+Resilience architecture (the round-3 run produced zero numbers because a
+~25-minute backend-init stall on the tunneled TPU consumed the whole budget
+before the first byte of JSON):
+
+- The PARENT process never imports jax. It probes the device in a killable
+  subprocess (75s timeout, 3 attempts with backoff), then runs each config
+  in its own subprocess with a hard per-config timeout.
+- Every result line is printed the instant it exists AND appended to
+  ``bench_partial.jsonl`` — a killed run still leaves everything it measured.
+- Children enable JAX's persistent compilation cache (``.jax_cache/``), so a
+  retried config skips its multi-minute XLA compile.
+- A total wall-clock budget (DS_BENCH_BUDGET_S, default 22 min) gates each
+  launch; configs that don't fit emit an explicit "skipped: budget" line.
+
 Printed order (the driver parses the LAST line as the headline):
 
+  1. GPT-2 125M ZeRO-1 training           (config 1, tokens/s/chip — headline, FIRST)
   2. llama-style ZeRO-3 fused training    (config 2, sized to one chip's HBM)
   3. ZeRO-Infinity max trainable params   (config 3, layer-streamed offload)
   4. 32k-sequence training                (config 4, flash attention + remat)
   5. MoE inference vs dense               (config 5, expert dispatch overhead)
-  1. GPT-2 125M ZeRO-1 training           (config 1, tokens/s/chip — headline)
+  1. headline re-emitted LAST
 
 ``vs_baseline`` semantics per line: training configs report measured MFU
 over the 0.40 north star (BASELINE.json); the Infinity line reports trained
@@ -18,6 +33,8 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
 import traceback
 
@@ -27,6 +44,23 @@ SEED = 0
 NORTH_STAR_MFU = 0.40
 # DS_BENCH_TINY=1: shrink every config so the whole bench smoke-tests on CPU
 TINY = os.environ.get("DS_BENCH_TINY") == "1"
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+def _enable_compile_cache():
+    """Persistent compilation cache: a retried config (same process tree or a
+    later bench run) skips the multi-minute from-scratch XLA compile."""
+    import jax
+
+    cache_dir = os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR", os.path.join(REPO, ".jax_cache")
+    )
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    except Exception:
+        pass  # older jax without these options: run uncached
 
 
 def _peak_tflops_bf16() -> float:
@@ -99,6 +133,7 @@ def bench_gpt2_zero1():
     from deepspeed_tpu.models import TransformerLM, gpt2_config
 
     seq, micro = (128, 2) if TINY else (1024, 8)
+    micro = int(os.environ.get("DS_BENCH_MICRO", micro))
     mcfg = gpt2_config("tiny" if TINY else "125m", max_seq_len=seq, remat=False)
     engine = _train_engine(
         TransformerLM(mcfg),
@@ -161,8 +196,9 @@ def bench_llama_zero3():
     rs = np.random.RandomState(SEED)
     toks = rs.randint(0, mcfg.vocab_size, (micro, seq + 1)).astype(np.int32)
     batch = {"input_ids": toks[:, :-1], "labels": toks[:, 1:]}
-    dt, _ = _timed_steps(engine, batch, warmup=2, steps=8)
-    tps = 8 * micro * seq / dt
+    steps = 8
+    dt, _ = _timed_steps(engine, batch, warmup=2, steps=steps)
+    tps = steps * micro * seq / dt
     mfu = _mfu(tps, engine.num_parameters(), mcfg.num_layers, mcfg.hidden_size, seq)
     # remat recomputes the forward in the backward: the chip does ~8N useful
     # FLOPs/token but MFU counts the 6N model FLOPs (standard accounting)
@@ -170,6 +206,7 @@ def bench_llama_zero3():
         "metric": "llama_0p8b_zero3_tokens_per_sec_per_chip",
         "value": round(tps, 1),
         "unit": "tokens/s/chip",
+        "steps": steps,
         "vs_baseline": round(mfu / NORTH_STAR_MFU, 4),
     }
 
@@ -260,13 +297,15 @@ def bench_long_seq():
     rs = np.random.RandomState(SEED)
     toks = rs.randint(0, mcfg.vocab_size, (micro, seq + 1)).astype(np.int32)
     batch = {"input_ids": toks[:, :-1], "labels": toks[:, 1:]}
-    dt, _ = _timed_steps(engine, batch, warmup=2, steps=5)
-    tps = 5 * micro * seq / dt
+    steps = 5
+    dt, _ = _timed_steps(engine, batch, warmup=2, steps=steps)
+    tps = steps * micro * seq / dt
     mfu = _mfu(tps, engine.num_parameters(), mcfg.num_layers, mcfg.hidden_size, seq)
     return {
         "metric": "seq32k_flash_tokens_per_sec_per_chip",
         "value": round(tps, 1),
         "unit": "tokens/s/chip",
+        "steps": steps,
         "vs_baseline": round(mfu / NORTH_STAR_MFU, 4),
     }
 
@@ -324,28 +363,191 @@ def bench_moe_inference():
     }
 
 
-def _run_one(fn):
+# ---------------------------------------------------------------------------
+# Orchestration. The parent never imports jax; every jax-touching activity
+# (including the device probe — backend init alone stalled 25 minutes in
+# round 3) runs in a subprocess the parent can kill.
+
+CONFIGS = {
+    "gpt2_zero1": (bench_gpt2_zero1, 420),
+    "llama_zero3": (bench_llama_zero3, 330),
+    "infinity": (bench_infinity_max_params, 360),
+    "long_seq": (bench_long_seq, 360),
+    "moe_inference": (bench_moe_inference, 300),
+}
+HEADLINE = "gpt2_zero1"
+PARTIAL_PATH = os.path.join(REPO, "bench_partial.jsonl")
+
+
+def _error_record(name, msg):
+    fn, _ = CONFIGS[name]
+    return {"metric": fn.__name__, "value": 0, "unit": f"error: {msg[:160]}", "vs_baseline": 0}
+
+
+def _run_child(args, timeout_s, log_path):
+    """Run ``python bench.py <args>`` in its own session; kill the whole
+    process group on timeout (jax spawns threads that survive a plain kill).
+    Returns (rc, timed_out)."""
+    with open(log_path, "ab") as log:
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)] + args,
+            stdout=log,
+            stderr=subprocess.STDOUT,
+            start_new_session=True,
+            cwd=REPO,
+        )
+        try:
+            return proc.wait(timeout=timeout_s), False
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(os.getpgid(proc.pid), 9)
+            except (ProcessLookupError, PermissionError):
+                proc.kill()
+            proc.wait()
+            return -9, True
+
+
+def _probe(budget_left):
+    """Probe the backend with short timeouts; returns (platform|None, detail).
+    The tunnel either answers in seconds or is down for hours — short
+    retries catch transient flake without burning the budget on a stall.
+    The result file, not the child's rc, is the success signal: a child that
+    wrote it and then hung in backend teardown still counts."""
+    log = os.path.join(REPO, "bench_child_probe.log")
+    out_path = os.path.join(REPO, ".bench_probe.json")
+    attempts = 3
+    detail = "no probe ran"
+    for attempt in range(attempts):
+        if os.path.exists(out_path):
+            os.remove(out_path)
+        timeout_s = min(75, max(20, budget_left()))
+        rc, timed_out = _run_child(["--child-probe"], timeout_s, log)
+        if os.path.exists(out_path):
+            try:
+                with open(out_path) as f:
+                    return json.load(f)["platform"], "ok"
+            except Exception:
+                return "unknown", "ok"
+        detail = (
+            f"probe {attempt + 1}/{attempts} "
+            + (f"timed out after {timeout_s:.0f}s" if timed_out else f"exited rc={rc}")
+        )
+        print(f"[bench] {detail}", file=sys.stderr, flush=True)
+        if budget_left() < 90:
+            break
+        if attempt < attempts - 1:
+            time.sleep(5 * (attempt + 1))
+    return None, detail
+
+
+def _child_probe():
+    import jax
+
+    devs = jax.devices()
+    with open(os.path.join(REPO, ".bench_probe.json"), "w") as f:
+        json.dump({"platform": devs[0].platform, "n": len(devs)}, f)
+
+
+def _child_run(name):
+    _enable_compile_cache()
+    fn, _ = CONFIGS[name]
     try:
-        return fn()
-    except Exception as e:  # one failed config must not kill the bench
+        rec = fn()
+    except Exception as e:
         traceback.print_exc()
-        return {
-            "metric": fn.__name__,
-            "value": 0,
-            "unit": f"error: {type(e).__name__}: {str(e)[:160]}",
-            "vs_baseline": 0,
-        }
+        rec = _error_record(name, f"{type(e).__name__}: {e}")
+    with open(os.path.join(REPO, f".bench_{name}.json"), "w") as f:
+        json.dump(rec, f)
 
 
 def main():
-    # headline FIRST (on record even if a later config hangs) and re-emitted
-    # LAST (the driver parses the final JSON line)
-    headline = _run_one(bench_gpt2_zero1)
-    print(json.dumps(headline), flush=True)
-    for fn in (bench_llama_zero3, bench_infinity_max_params, bench_long_seq, bench_moe_inference):
-        print(json.dumps(_run_one(fn)), flush=True)
-    print(json.dumps(headline), flush=True)
+    t_start = time.monotonic()
+    budget = float(os.environ.get("DS_BENCH_BUDGET_S", "1320"))  # 22 min
+
+    def budget_left():
+        return budget - (time.monotonic() - t_start)
+
+    cache_dir = os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR", os.path.join(REPO, ".jax_cache")
+    )
+    os.makedirs(cache_dir, exist_ok=True)
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = cache_dir
+
+    open(PARTIAL_PATH, "w").close()
+    results = {}
+
+    def emit(rec):
+        line = json.dumps(rec)
+        print(line, flush=True)
+        with open(PARTIAL_PATH, "a") as f:
+            f.write(line + "\n")
+
+    platform, probe_detail = _probe(budget_left)
+    if platform is None:
+        # No usable backend at all: emit honest error lines and exit 0 so the
+        # driver records parsed (non-null) output instead of a timeout.
+        for name in CONFIGS:
+            emit(_error_record(name, f"backend unavailable: {probe_detail}"))
+        emit(_error_record(HEADLINE, f"backend unavailable: {probe_detail}"))
+        return
+    print(f"[bench] backend ready: {platform}", file=sys.stderr, flush=True)
+
+    def run_config(name, retries=0):
+        fn, timeout_s = CONFIGS[name]
+        out_path = os.path.join(REPO, f".bench_{name}.json")
+        log_path = os.path.join(REPO, f"bench_child_{name}.log")
+        for attempt in range(retries + 1):
+            left = budget_left()
+            if left < 75:
+                return _error_record(name, f"skipped: budget ({left:.0f}s left)")
+            eff = min(timeout_s, left - 15)
+            if os.path.exists(out_path):
+                os.remove(out_path)
+            rc, timed_out = _run_child(["--child-run", name], eff, log_path)
+            # The result file, not rc, is the success signal: the file is
+            # deleted before each launch, so its existence proves THIS
+            # attempt measured something — even if the child then hung in
+            # backend teardown and was killed.
+            rec = None
+            if os.path.exists(out_path):
+                try:
+                    with open(out_path) as f:
+                        rec = json.load(f)
+                except Exception:
+                    rec = None
+            if rec is not None:
+                # a child-level exception already produced an error record;
+                # retry those too (warm compile cache makes retries cheap)
+                if not str(rec.get("unit", "")).startswith("error:") or attempt == retries:
+                    return rec
+            elif attempt == retries:
+                msg = f"timeout after {eff:.0f}s" if timed_out else f"child rc={rc}"
+                return _error_record(name, msg)
+            print(f"[bench] retrying {name}", file=sys.stderr, flush=True)
+        return _error_record(name, "unreachable")
+
+    # Headline first — on record even if everything after stalls.
+    results[HEADLINE] = run_config(HEADLINE, retries=1)
+    emit(results[HEADLINE])
+    for name in ("llama_zero3", "infinity", "long_seq", "moe_inference"):
+        results[name] = run_config(name)
+        emit(results[name])
+
+    # The driver parses the LAST line as the headline, so the last line is
+    # ALWAYS config 1's record — never a different config mislabeled as the
+    # headline. If the headline errored earlier but budget remains, give it
+    # one more try now (the compile cache is warm from the earlier attempts).
+    if str(results[HEADLINE].get("unit", "")).startswith("error:") and budget_left() > 120:
+        retry = run_config(HEADLINE)
+        if not str(retry.get("unit", "")).startswith("error:"):
+            results[HEADLINE] = retry
+    emit(results[HEADLINE])
 
 
 if __name__ == "__main__":
-    main()
+    if "--child-probe" in sys.argv:
+        _child_probe()
+    elif "--child-run" in sys.argv:
+        _child_run(sys.argv[sys.argv.index("--child-run") + 1])
+    else:
+        main()
