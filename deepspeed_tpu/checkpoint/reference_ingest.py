@@ -22,6 +22,7 @@ GSPMD partitioner reshards on placement.
 from __future__ import annotations
 
 import glob
+import logging
 import os
 import re
 from typing import Any, Dict, List, Optional, Tuple
@@ -99,6 +100,16 @@ def _model_state_files(path: str) -> List[str]:
         )
     files = sorted(glob.glob(os.path.join(path, "mp_rank_*_model_states.pt")))
     if not files:
+        # stage-3 reference checkpoints scatter module states across dp ranks
+        # (zero_pp_rank_{dp}_mp_rank_{mp}_model_states.pt) — name the layout
+        # instead of a bare FileNotFoundError
+        if glob.glob(os.path.join(path, "*zero_pp_rank_*_model_states.pt")):
+            raise NotImplementedError(
+                "ZeRO stage-3 reference checkpoints (per-dp-rank "
+                "zero_pp_rank_*_model_states.pt module files) are not "
+                "ingestable; consolidate with the reference's "
+                "zero_to_fp32.py or ds_to_universal first"
+            )
         raise FileNotFoundError(f"no mp_rank_*_model_states.pt under {path}")
     return files
 
@@ -147,12 +158,17 @@ def merge_reference_zero_fp32(
                 f"{mf} records no param_shapes; cannot reconstruct fp32 "
                 "masters from flat ZeRO partitions"
             )
+        # bf16 runs prefix the shards (engine _get_zero_ckpt_prefix):
+        # bf16_zero_pp_rank_{dp}_mp_rank_{mp}_optim_states.pt
         zfiles = sorted(
-            glob.glob(os.path.join(path, f"zero_pp_rank_*_mp_rank_{mp:02d}_optim_states.pt")),
+            glob.glob(os.path.join(path, f"zero_pp_rank_*_mp_rank_{mp:02d}_optim_states.pt"))
+            or glob.glob(os.path.join(path, f"bf16_zero_pp_rank_*_mp_rank_{mp:02d}_optim_states.pt")),
             key=lambda p: int(re.search(r"zero_pp_rank_(\d+)_", p).group(1)),
         )
         if not zfiles:
-            raise FileNotFoundError(f"no zero_pp_rank_*_mp_rank_{mp:02d} files under {path}")
+            raise FileNotFoundError(
+                f"no (bf16_)zero_pp_rank_*_mp_rank_{mp:02d} files under {path}"
+            )
         zstates = [_torch_load(f)["optimizer_state_dict"] for f in zfiles]
         n_groups = len(shapes_groups)
         out: Dict[str, np.ndarray] = {}
@@ -203,7 +219,12 @@ def ingest_reference_checkpoint(
             fp32 = merge_reference_zero_fp32(ckpt_dir, mtype, tag)
             sd = {**sd, **fp32}
             meta["weights_from"] = "zero_fp32_masters"
-        except (FileNotFoundError, ValueError):
+        except (FileNotFoundError, ValueError) as e:
+            log_dist(
+                f"use_zero_fp32 requested but falling back to module states: {e}",
+                ranks=[0],
+                level=logging.WARNING,
+            )
             meta["weights_from"] = "module_states"
     else:
         meta["weights_from"] = "module_states"

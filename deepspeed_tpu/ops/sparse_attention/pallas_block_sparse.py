@@ -98,7 +98,10 @@ def _fwd_kernel(row_idx, row_cnt, q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s,
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         corr = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)
+        # NEG_INF is finite, so exp(s - m_new) would be 1 (not 0) on rows
+        # whose every listed block is causally dead; zero them explicitly so
+        # fully-masked rows finish with l=0 → o=0, lse=NEG_INF.
+        p = jnp.where(s > NEG_INF / 2, jnp.exp(s - m_new), 0.0)
         l_new = corr * l_prev + jnp.sum(p, axis=1, keepdims=True)
         acc_s[...] = acc_s[...] * corr + jax.lax.dot(p, v, preferred_element_type=jnp.float32)
         m_s[...] = jnp.broadcast_to(m_new, m_s.shape)
@@ -176,7 +179,9 @@ def _dq_kernel(row_idx, row_cnt, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref
         delta = delta_ref[0][:, :1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         s = _pair_mask(s * scale, qi, ki, blk, causal)
-        p = jnp.exp(s - lse)  # rows with lse=-inf produce p=0
+        # masked entries have s=NEG_INF (finite): exp(s - lse) is 1, not 0,
+        # when lse is also NEG_INF (fully-masked row) — zero them explicitly
+        p = jnp.where(s > NEG_INF / 2, jnp.exp(s - lse), 0.0)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
         dq_s[...] += jax.lax.dot(ds, k, preferred_element_type=jnp.float32)
@@ -206,7 +211,7 @@ def _dkv_kernel(col_idx, col_cnt, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_re
         delta = delta_ref[0][:, :1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         s = _pair_mask(s * scale, qi, ki, blk, causal)
-        p = jnp.exp(s - lse)
+        p = jnp.where(s > NEG_INF / 2, jnp.exp(s - lse), 0.0)
         dv_s[...] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale

@@ -155,7 +155,10 @@ def paged_decode_attention(
     sequence's cache is a list of pages in a shared pool, so prefixes can be
     shared and memory allocates page-granular. The kernel's kv grid walks
     the page table via scalar prefetch (k/v BlockSpecs jump straight to the
-    page), skipping table slots past the live length."""
+    page). Compute for table slots past the live length is skipped, but the
+    block FETCH is not (pl.when gates the body, not the BlockSpec), so the
+    index map clamps ids into [0, NP): tables padded with -1 or sentinel
+    ids >= NP read a valid page whose scores are then masked out."""
     B, NH, D = q.shape
     NP, NKV, P, Dk = k_pages.shape
     assert Dk == D and v_pages.shape == k_pages.shape
@@ -179,8 +182,8 @@ def paged_decode_attention(
         grid=(B, NKV, maxp),
         in_specs=[
             pl.BlockSpec((1, 1, Hg, D), lambda b, g, ki, pt, ln: (b, g, 0, 0)),
-            pl.BlockSpec((1, 1, P, D), lambda b, g, ki, pt, ln: (pt[b, ki], g, 0, 0)),
-            pl.BlockSpec((1, 1, P, D), lambda b, g, ki, pt, ln: (pt[b, ki], g, 0, 0)),
+            pl.BlockSpec((1, 1, P, D), lambda b, g, ki, pt, ln: (jnp.clip(pt[b, ki], 0, NP - 1), g, 0, 0)),
+            pl.BlockSpec((1, 1, P, D), lambda b, g, ki, pt, ln: (jnp.clip(pt[b, ki], 0, NP - 1), g, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, Hg, D), lambda b, g, ki, pt, ln: (b, g, 0, 0)),
         scratch_shapes=[

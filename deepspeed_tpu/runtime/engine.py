@@ -1433,6 +1433,14 @@ class DeepSpeedEngine:
             return self._param_stream.gathered_params()
         return self._params
 
+    def get_param_treedef(self):
+        """Tree structure of ``get_params()`` without materializing it — on
+        the offload path ``gathered_params`` copies the whole model to host,
+        which structure checks (zero.GatheredParameters) must not pay for."""
+        if self._param_stream is not None:
+            return self._param_stream.params_treedef()
+        return jax.tree_util.tree_structure(self._params)
+
     def get_last_grads(self):
         """Gradient tree of the latest training micro-batch (debug/inspection
         surface behind ``safe_get_full_grad``). On the accumulating path this

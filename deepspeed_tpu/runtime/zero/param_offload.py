@@ -517,6 +517,14 @@ class ParamStreamEngine:
     # ------------------------------------------------------------------
     # introspection / checkpoint
     # ------------------------------------------------------------------
+    def params_treedef(self):
+        """Tree structure of ``gathered_params()`` with no layer copies."""
+        out = dict(self.resident)
+        out["layers"] = jax.tree_util.tree_unflatten(
+            self.store._treedef, [0] * len(self.store._shapes)
+        )
+        return jax.tree_util.tree_structure(out)
+
     def gathered_params(self):
         """Full compute-dtype param tree (host-backed stacked layers).
 

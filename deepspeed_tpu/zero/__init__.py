@@ -87,9 +87,11 @@ class GatheredParameters(contextlib.AbstractContextManager):
             params = engine.get_params()
             self._is_full_tree = True
         else:
+            # compare against the engine's treedef, NOT get_params(): the
+            # offload path's gathered_params materializes the full model
+            # host-side, far too expensive for a structure check
             self._is_full_tree = engine is not None and (
-                jax.tree_util.tree_structure(params)
-                == jax.tree_util.tree_structure(engine.get_params())
+                jax.tree_util.tree_structure(params) == engine.get_param_treedef()
             )
         self.params = params
         self.modifier_rank = modifier_rank

@@ -162,3 +162,30 @@ def test_pipeline_layout_rejected(tmp_path):
         f.write("step1")
     with pytest.raises(NotImplementedError, match="pipeline"):
         merge_reference_model_states(str(tmp_path / "ref"), "megatron_gpt")
+
+
+def test_bf16_prefixed_zero_shards(tmp_path):
+    """bf16 runs name their ZeRO shards bf16_zero_pp_rank_* (engine
+    _get_zero_ckpt_prefix); the fp32-reconstruction glob must find them."""
+    sd = _megatron_sd(L=2, H=32, NH=4, V=128, I=64)
+    root = str(tmp_path / "ref")
+    os.makedirs(root)
+    path = _write_reference_ckpt(root, sd)
+    for f in os.listdir(path):
+        if f.startswith("zero_pp_rank_"):
+            os.rename(os.path.join(path, f), os.path.join(path, "bf16_" + f))
+    fp32 = merge_reference_zero_fp32(root, "megatron_gpt")
+    for name, w in sd.items():
+        np.testing.assert_allclose(fp32[name], np.asarray(w, np.float32) + 7.0, rtol=1e-6)
+
+
+def test_stage3_layout_explicit_error(tmp_path):
+    """Stage-3 reference checkpoints (zero_pp_rank_*_model_states.pt) must
+    raise a clear unsupported-layout message, not FileNotFoundError."""
+    path = tmp_path / "ref" / "global_step3"
+    path.mkdir(parents=True)
+    torch.save({}, str(path / "zero_pp_rank_0_mp_rank_00_model_states.pt"))
+    with open(str(tmp_path / "ref" / "latest"), "w") as f:
+        f.write("global_step3")
+    with pytest.raises(NotImplementedError, match="stage-3|zero_pp_rank"):
+        merge_reference_model_states(str(tmp_path / "ref"), "megatron_gpt")

@@ -209,3 +209,29 @@ class TestPagedDecodeAttention:
             jnp.asarray(q), jnp.asarray(pool_k2), jnp.asarray(pool_v), table, np.array([200])
         )
         np.testing.assert_allclose(np.asarray(out1), np.asarray(out2), rtol=1e-6)
+
+    def test_padding_slots_with_sentinel_ids(self):
+        """Serving stacks pad page tables with -1 (or ids >= NP) past the
+        live length; the index map must clamp those fetches in-range rather
+        than read out of bounds, and their scores are masked anyway."""
+        from deepspeed_tpu.ops.transformer.decode_attention import (
+            paged_decode_attention,
+        )
+
+        NH, D, page = 2, 32, 128
+        rs = np.random.RandomState(3)
+        pool_k = rs.randn(3, NH, page, D).astype(np.float32)
+        pool_v = rs.randn(3, NH, page, D).astype(np.float32)
+        q = rs.randn(2, NH, D).astype(np.float32)
+        lens = np.array([130, 256], np.int32)
+        valid = np.array([[1, 2, 0, 0], [2, 0, 0, 0]], np.int32)
+        padded = np.array([[1, 2, -1, 99], [2, 0, -1, -1]], np.int32)
+        out_valid = paged_decode_attention(
+            jnp.asarray(q), jnp.asarray(pool_k), jnp.asarray(pool_v), valid, lens
+        )
+        out_padded = paged_decode_attention(
+            jnp.asarray(q), jnp.asarray(pool_k), jnp.asarray(pool_v), padded, lens
+        )
+        np.testing.assert_allclose(
+            np.asarray(out_valid), np.asarray(out_padded), rtol=1e-6
+        )

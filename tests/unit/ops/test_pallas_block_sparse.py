@@ -131,3 +131,41 @@ def test_work_scales_with_live_blocks():
     row_idx, row_cnt, _, _ = build_block_tables(layout[0])
     nb = T // BLOCK
     assert row_idx.shape[1] < nb / 2, (row_idx.shape, nb)
+
+
+def test_causally_dead_rows_zero_fwd_and_bwd():
+    """A custom layout whose q-block 0 only lists a strictly-future kv block
+    (causal): those rows have no live scores, so the forward must emit 0 (not
+    mean(v) — NEG_INF is finite, exp(s-m)=1 without explicit zeroing) and all
+    gradients flowing through them must be 0, not garbage."""
+    T = 64  # 4 blocks of BLOCK=16
+    q, k, v = _qkv(T, seed=7)
+    layout = np.zeros((1, 4, 4), bool)
+    layout[0, 0, 3] = True  # q-block 0 → only future kv-block 3: fully dead
+    layout[0, 1, 1] = True
+    layout[0, 2, 2] = True
+    layout[0, 2, 0] = True
+    layout[0, 3, 3] = True
+
+    out = pallas_block_sparse_attention(q, k, v, layout, BLOCK, causal=True)
+    ref = _dense_oracle(q, k, v, layout, BLOCK, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+    assert np.all(np.asarray(out)[:, :, :BLOCK, :] == 0.0), "dead rows must output 0"
+
+    def sparse_loss(q, k, v):
+        o = pallas_block_sparse_attention(q, k, v, layout, BLOCK, causal=True)
+        return jnp.sum(o * jnp.cos(o))
+
+    def dense_loss(q, k, v):
+        o = _dense_oracle(q, k, v, layout, BLOCK, True)
+        return jnp.sum(o * jnp.cos(o))
+
+    gs = jax.grad(sparse_loss, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b, label in zip(gs, gd, "qkv"):
+        assert np.all(np.isfinite(np.asarray(a))), f"d{label} not finite"
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-5, atol=5e-5, err_msg=f"d{label}"
+        )
+    # dead q rows get zero dq
+    assert np.all(np.asarray(gs[0])[:, :, :BLOCK, :] == 0.0)

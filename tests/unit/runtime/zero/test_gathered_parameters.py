@@ -124,3 +124,17 @@ def test_sparse_gradients_rejected():
                 "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
             },
         )
+
+
+def test_structure_check_does_not_materialize_params(eight_devices):
+    """The full-tree structure check must use the engine's treedef, not
+    get_params(): on the offload path gathered_params copies the whole model
+    to host just to compare shapes (round-3 advisory)."""
+    engine, _ = _engine()
+    real = engine.get_params()
+    calls = []
+    orig = engine.get_params
+    engine.get_params = lambda: calls.append(1) or orig()
+    with GatheredParameters(params=real, modifier_rank=0, engine=engine) as p:
+        pass
+    assert not calls, "structure check materialized the full param tree"
