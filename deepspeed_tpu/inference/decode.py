@@ -191,6 +191,9 @@ def build_decoder(cfg: TransformerConfig) -> Tuple[Any, Any]:
     return prefill, decode_step
 
 
+_loop_cache: Dict[Tuple, Any] = {}
+
+
 def generate(
     cfg: TransformerConfig,
     params,
@@ -199,38 +202,92 @@ def generate(
     eos_token_id=None,
     temperature: float = 0.0,
     rng=None,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    pad_token_id: int = 0,
+    dtype=None,
 ):
-    """KV-cached greedy/sampled generation: one prefill + N decode steps
-    (each a cached compiled program)."""
+    """KV-cached generation: one jitted prefill + ONE jitted decode loop.
+
+    The whole token-by-token loop is a single compiled ``lax.while_loop``
+    program — sampling (greedy / temperature / top-k / top-p,
+    ``inference/sampling.py``) and the EOS check run on device, so the only
+    host round-trip of the entire generation is fetching the final token
+    array. The loop exits early on device once every row has emitted EOS
+    (rows finished earlier keep emitting EOS as padding).
+
+    Replaces the reference's per-token kernel-launch loop
+    (``deepspeed/inference/engine.py:578`` → HF generate) — same sampling
+    controls, but batched into two XLA programs per (batch, lengths,
+    sampling-config) bucket.
+    """
+    import functools
+
+    from deepspeed_tpu.inference.sampling import sample_logits
+
     tokens = jnp.asarray(input_ids)
     if tokens.ndim == 1:
         tokens = tokens[None, :]
     B, prompt_len = tokens.shape
     max_len = prompt_len + max_new_tokens
-    cache = init_cache(cfg, B, max_len)
-    prefill, decode_step = build_decoder(cfg)
-
+    cache = init_cache(cfg, B, max_len, dtype=dtype)
+    prefill, _ = build_decoder(cfg)
     logits, cache = prefill(params, tokens, cache)
-    out = [tokens]
-    pos = prompt_len
-    finished = np.zeros(B, bool)
-    for _ in range(max_new_tokens):
-        if temperature > 0.0 and rng is not None:
-            rng, sub = jax.random.split(rng)
-            next_tok = jax.random.categorical(sub, logits / temperature, axis=-1)
-        else:
-            next_tok = jnp.argmax(logits, axis=-1)
-        next_tok = next_tok.astype(tokens.dtype)
-        if eos_token_id is not None:
-            # rows that already emitted EOS keep emitting EOS (padding), not
-            # arbitrary continuation tokens
-            next_tok = jnp.where(jnp.asarray(finished), jnp.asarray(eos_token_id, tokens.dtype), next_tok)
-            out.append(next_tok[:, None])
-            finished |= np.asarray(jax.device_get(next_tok)) == eos_token_id
-            if finished.all():
-                break
-        else:
-            out.append(next_tok[:, None])
-        logits, cache = decode_step(params, next_tok, cache, jnp.int32(pos))
-        pos += 1
-    return jnp.concatenate(out, axis=1)
+    if rng is None:
+        # no rng = greedy (matching sample_logits), never a silently fixed
+        # key masquerading as randomness; the carry still needs a key object
+        temperature = 0.0
+        rng = jax.random.PRNGKey(0)
+
+    key = (
+        id(cfg), B, prompt_len, max_new_tokens, eos_token_id,
+        float(temperature), int(top_k), float(top_p), int(pad_token_id),
+        str(tokens.dtype), str(cache.k.dtype),
+    )
+    loop = _loop_cache.get(key)
+    if loop is None:
+        sample = functools.partial(
+            sample_logits, temperature=temperature, top_k=top_k, top_p=top_p
+        )
+
+        def _loop(params, logits, cache, rng, out):
+            def cond(c):
+                step, _, _, _, _, finished = c
+                return jnp.logical_and(
+                    step < max_new_tokens, jnp.logical_not(jnp.all(finished))
+                )
+
+            def body(c):
+                step, logits, cache, rng, out, finished = c
+                rng, sub = jax.random.split(rng)
+                tok = sample(logits, sub).astype(out.dtype)
+                if eos_token_id is not None:
+                    tok = jnp.where(
+                        finished, jnp.asarray(eos_token_id, out.dtype), tok
+                    )
+                out = jax.lax.dynamic_update_slice(
+                    out, tok[:, None], (0, prompt_len + step)
+                )
+                if eos_token_id is not None:
+                    finished = finished | (tok == eos_token_id)
+                logits, cache = _forward_with_cache(
+                    cfg, params, tok[:, None], cache, prompt_len + step
+                )
+                return (step + 1, logits, cache, rng, out, finished)
+
+            state = (
+                jnp.int32(0), logits, cache, rng, out, jnp.zeros((B,), bool)
+            )
+            step, _, cache, _, out, _ = jax.lax.while_loop(cond, body, state)
+            # the final cache is returned (and ignored by the caller) so the
+            # donated input cache can alias an output instead of being copied
+            # into the loop carry
+            return out, step, cache
+
+        loop = jax.jit(_loop, donate_argnums=(2, 4))
+        _loop_cache[key] = loop
+
+    out0 = jnp.full((B, max_len), pad_token_id, tokens.dtype)
+    out0 = jax.lax.dynamic_update_slice(out0, tokens, (0, 0))
+    out, n_emitted, _ = loop(params, logits, cache, rng, out0)
+    return out[:, : prompt_len + int(jax.device_get(n_emitted))]

@@ -208,28 +208,40 @@ class InferenceEngine:
         max_new_tokens: int = 32,
         eos_token_id: Optional[int] = None,
         pad_token_id: int = 0,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
     ):
-        """Greedy decode, one compiled program per (batch, max_len) bucket.
-        The module's apply must return logits [B, T, V] for a token-id array;
-        the paged KV-cache decode path replaces the full-seq forward later."""
+        """Token generation (greedy by default; temperature/top-k/top-p
+        sampling like the reference's HF-generate dispatch,
+        ``deepspeed/inference/engine.py:578``). Kernel-injected models take
+        the KV-cached single-program decode loop; arbitrary modules get one
+        full-forward compiled program per (batch, max_len) bucket."""
         from deepspeed_tpu.inference.generation import greedy_generate
 
         if self._zero_config is not None:
             if self._param_stream is None:
                 self.init_params(jnp.asarray(input_ids))
             return self._zero_generate(
-                input_ids, max_new_tokens, eos_token_id, pad_token_id
+                input_ids, max_new_tokens, eos_token_id, pad_token_id,
+                temperature=temperature, top_k=top_k, top_p=top_p,
             )
         if self._ds_config is not None and self._params is not None:
-            # kernel-injected path: KV-cached prefill + per-token decode
+            # kernel-injected path: KV-cached prefill + on-device decode loop
             from deepspeed_tpu.inference.decode import generate as kv_generate
 
+            self._rng, sub = jax.random.split(self._rng)
             return kv_generate(
                 self._ds_config,
                 self._params,
                 input_ids,
                 max_new_tokens,
                 eos_token_id=eos_token_id,
+                temperature=temperature,
+                rng=sub,
+                top_k=top_k,
+                top_p=top_p,
+                pad_token_id=pad_token_id,
             )
         if self._params is None:
             self.init_params(jnp.asarray(input_ids))
@@ -250,15 +262,22 @@ class InferenceEngine:
             eos_token_id=eos_token_id,
             pad_token_id=pad_token_id,
             jit_cache=self._gen_cache,
+            temperature=temperature,
+            top_k=top_k,
+            top_p=top_p,
         )
 
-    def _zero_generate(self, input_ids, max_new_tokens, eos_token_id, pad_token_id):
-        """Greedy decode with layer-streamed params (ZeRO-Inference).
+    def _zero_generate(self, input_ids, max_new_tokens, eos_token_id, pad_token_id,
+                       temperature=0.0, top_k=0, top_p=1.0):
+        """Decode with layer-streamed params (ZeRO-Inference); greedy or
+        temperature/top-k/top-p sampled like the in-HBM paths.
 
         Every step re-runs the full fixed-shape forward (one compile) and
         streams all layers through HBM — the reference's capacity-first
         trade (15T params on one GPU at batch-latency cost,
         docs/_posts/2022-09-10-zero-inference.md)."""
+        from deepspeed_tpu.inference.sampling import sample_logits
+
         tokens = np.asarray(input_ids)
         if tokens.ndim == 1:
             tokens = tokens[None, :]
@@ -271,7 +290,11 @@ class InferenceEngine:
             logits = np.asarray(
                 self._param_stream.eval_forward(jnp.asarray(padded), None)
             )
-            nxt = logits[:, cur - 1].argmax(-1).astype(padded.dtype)
+            self._rng, sub = jax.random.split(self._rng)
+            nxt = np.asarray(
+                sample_logits(jnp.asarray(logits[:, cur - 1]), sub,
+                              temperature=temperature, top_k=top_k, top_p=top_p)
+            ).astype(padded.dtype)
             if eos_token_id is not None:
                 nxt = np.where(finished, pad_token_id, nxt)
             padded[:, cur] = nxt

@@ -1,23 +1,29 @@
-"""Greedy decoding with a single compiled program.
+"""Full-forward generation fallback (any apply_fn) with one compiled step.
 
 The naive loop regrows the token array each step, recompiling per length.
 Here the sequence is padded once to ``prompt_len + max_new_tokens`` and a
-jitted step reads the logits at a *traced* cursor and writes the next token
-in place (``dynamic_update_slice``), so XLA compiles exactly one program per
-(batch, max_len) bucket. Causality makes the padding harmless: positions
-≥ cursor cannot influence the logits at cursor-1 in a causal model.
+jitted step reads the logits at a *traced* cursor, samples (greedy /
+temperature / top-k / top-p), and writes the next token in place
+(``dynamic_update_slice``), so XLA compiles exactly one program per
+(batch, max_len, sampling-config) bucket. Causality makes the padding
+harmless: positions ≥ cursor cannot influence the logits at cursor-1.
 
-This is the interim decode path; the paged KV-cache attention kernel replaces
-the full-sequence forward for long generations.
+EOS tracking stays on device; the host only fetches the all-finished
+scalar every ``eos_check_every`` steps (a per-token ``device_get`` would
+serialize the loop on the host link).
+
+This is the O(steps × full forward) fallback for arbitrary models; the
+flagship ``TransformerLM`` layout takes the KV-cached single-program path
+in ``inference/decode.py`` instead.
 """
 
 from __future__ import annotations
 
+import functools
 from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 
 def greedy_generate(
@@ -29,7 +35,13 @@ def greedy_generate(
     eos_token_id: Optional[int] = None,
     pad_token_id: int = 0,
     jit_cache: Optional[dict] = None,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    eos_check_every: int = 8,
 ):
+    from deepspeed_tpu.inference.sampling import sample_logits
+
     tokens = jnp.asarray(input_ids)
     if tokens.ndim == 1:
         tokens = tokens[None, :]
@@ -38,27 +50,54 @@ def greedy_generate(
     padded = jnp.full((batch, max_len), pad_token_id, dtype=tokens.dtype)
     padded = jax.lax.dynamic_update_slice(padded, tokens, (0, 0))
 
-    cache_key = ("greedy_step", batch, max_len)
+    cache_key = (
+        "gen_step", batch, max_len, eos_token_id,
+        float(temperature), int(top_k), float(top_p),
+    )
     if jit_cache is not None and cache_key in jit_cache:
         step = jit_cache[cache_key]
     else:
+        sample = functools.partial(
+            sample_logits, temperature=temperature, top_k=top_k, top_p=top_p
+        )
 
-        def _step(params, padded, cursor, rng):
+        def _step(params, padded, cursor, rng, finished):
             logits = apply_fn(params, padded, rng)
             last = jax.lax.dynamic_index_in_dim(logits, cursor - 1, axis=1, keepdims=False)
-            next_tok = jnp.argmax(last, axis=-1).astype(padded.dtype)
+            next_tok = sample(last, rng).astype(padded.dtype)
+            if eos_token_id is not None:
+                next_tok = jnp.where(
+                    finished, jnp.asarray(eos_token_id, padded.dtype), next_tok
+                )
+                finished = finished | (next_tok == eos_token_id)
             out = jax.lax.dynamic_update_slice(padded, next_tok[:, None], (0, cursor))
-            return out, next_tok
+            return out, finished, jnp.all(finished)
 
         step = jax.jit(_step, donate_argnums=(1,))
         if jit_cache is not None:
             jit_cache[cache_key] = step
 
+    import numpy as np
+
     cursor = prompt_len
-    for _ in range(max_new_tokens):
+    finished = jnp.zeros((batch,), bool)
+    for i in range(max_new_tokens):
         rng, sub = jax.random.split(rng)
-        padded, next_tok = step(params, padded, jnp.int32(cursor), sub)
+        padded, finished, all_done = step(params, padded, jnp.int32(cursor), sub, finished)
         cursor += 1
-        if eos_token_id is not None and bool(np.all(jax.device_get(next_tok) == eos_token_id)):
-            break
+        if eos_token_id is not None and (
+            (i + 1) % eos_check_every == 0 or i == max_new_tokens - 1
+        ):
+            if bool(jax.device_get(all_done)):
+                break
+    if eos_token_id is not None:
+        # trim the up-to-(eos_check_every-1) trailing EOS columns emitted
+        # between the last real token and the host check, so the returned
+        # length matches the KV-cached path exactly (one emit past each
+        # row's first EOS, nothing more)
+        emitted = np.asarray(jax.device_get(padded[:, prompt_len:cursor]))
+        hit = emitted == eos_token_id
+        last = hit.argmax(1)  # first EOS per row (0 if none)
+        per_row = np.where(hit.any(1), last + 1, emitted.shape[1])
+        cursor = prompt_len + int(per_row.max())
     return padded[:, :cursor]

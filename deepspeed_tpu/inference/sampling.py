@@ -1,0 +1,82 @@
+"""Jittable token sampling: greedy, temperature, top-k, top-p.
+
+The reference defers sampling to HF ``generate`` kwargs
+(``deepspeed/inference/engine.py:578`` dispatches to the wrapped module's
+generate, which applies HF's LogitsProcessor stack). Here the filters are
+pure jnp transforms fused INTO the compiled decode loop — sampling adds no
+host round-trip and no extra kernel launch.
+
+All of ``temperature`` / ``top_k`` / ``top_p`` are static Python values
+(compile-time constants): each distinct sampling configuration is its own
+compiled program, matching how serving stacks bucket by sampling params.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def top_k_filter(logits: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Mask everything below the k-th largest logit (per row)."""
+    if k <= 0:
+        return logits
+    k = min(k, logits.shape[-1])
+    kth = jnp.sort(logits, axis=-1)[..., -k][..., None]
+    return jnp.where(logits < kth, NEG_INF, logits)
+
+
+def top_p_filter(logits: jnp.ndarray, p: float) -> jnp.ndarray:
+    """Nucleus filter: keep the smallest prefix of the sorted distribution
+    whose cumulative probability reaches ``p`` (the top token always
+    survives, even when ``p`` is 0 or its probability alone exceeds it)."""
+    if p >= 1.0:
+        return logits
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    cutoff = _nucleus_cutoff(sorted_logits, p)
+    return jnp.where(logits < cutoff, NEG_INF, logits)
+
+
+def _nucleus_cutoff(sorted_desc: jnp.ndarray, p: float) -> jnp.ndarray:
+    """Smallest logit inside the nucleus of a descending-sorted row."""
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # shifting the comparison by one slot keeps the boundary token
+    keep = (cum - probs) < p
+    keep = keep.at[..., 0].set(True)  # the top token always survives
+    kept_logits = jnp.where(keep, sorted_desc, jnp.inf)
+    return jnp.min(kept_logits, axis=-1, keepdims=True)
+
+
+def sample_logits(
+    logits: jnp.ndarray,  # [B, V]
+    rng: Optional[jax.Array],
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+) -> jnp.ndarray:
+    """Next-token ids [B]. ``temperature == 0`` (or no rng) = greedy; the
+    filters compose the HF order: top-k, then top-p over the k-filtered
+    distribution, then categorical. One vocab sort serves both filters —
+    this runs inside the per-token decode loop, so the O(V log V) work is
+    not duplicated."""
+    if temperature <= 0.0 or rng is None:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    if top_k > 0 or top_p < 1.0:
+        sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+        if top_k > 0:
+            k = min(top_k, logits.shape[-1])
+            kth = sorted_desc[..., k - 1][..., None]
+            sorted_desc = jnp.where(sorted_desc < kth, NEG_INF, sorted_desc)
+            cutoff = kth
+        if top_p < 1.0:
+            # nucleus over the (possibly k-filtered) distribution; its
+            # cutoff is >= the kth value, so it subsumes the top-k cutoff
+            cutoff = _nucleus_cutoff(sorted_desc, top_p)
+        logits = jnp.where(logits < cutoff, NEG_INF, logits)
+    return jax.random.categorical(rng, logits, axis=-1)

@@ -37,23 +37,59 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         self._in_inference_mode = not mode
         return super().train(mode)
 
-    def generate(self, input_ids, max_new_tokens: Optional[int] = None, eos_token_id: Optional[int] = None, pad_token_id: int = 0):
-        """Greedy decode with the CURRENT training weights (the RLHF actor
-        rollout step); one compiled program per (batch, max_len) bucket. The
-        module's apply must return logits for a token-id batch."""
-        from deepspeed_tpu.inference.generation import greedy_generate
+    def generate(
+        self,
+        input_ids,
+        max_new_tokens: Optional[int] = None,
+        eos_token_id: Optional[int] = None,
+        pad_token_id: int = 0,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+    ):
+        """Rollout with the CURRENT training weights (the RLHF actor step).
 
+        ``TransformerLM``-layout modules take the KV-cached path
+        (``inference/decode.py``): one jitted prefill + one jitted on-device
+        decode loop over the live sharded params — the fast cached rollout
+        that is the reference hybrid engine's whole point
+        (``deepspeed/runtime/hybrid_engine.py:32``, kernel-injected
+        inference inside training). Other modules fall back to the
+        full-forward-per-token program. Both support greedy and
+        temperature/top-k/top-p sampling."""
         if not self._initialized:
             self.init_params(jnp.asarray(input_ids))
         max_new = max_new_tokens or self.max_out_tokens
         module = self.module
+        self._rng, sub = jax.random.split(self._rng)
+
+        from deepspeed_tpu.models.transformer import TransformerLM
+
+        if isinstance(module, TransformerLM) and self._params is not None:
+            from deepspeed_tpu.inference.decode import generate as kv_generate
+
+            leaf = jax.tree_util.tree_leaves(self._params["embed"])[0]
+            return kv_generate(
+                module.config,
+                self._params,
+                input_ids,
+                max_new,
+                eos_token_id=eos_token_id,
+                temperature=temperature,
+                rng=sub,
+                top_k=top_k,
+                top_p=top_p,
+                pad_token_id=pad_token_id,
+                dtype=leaf.dtype,  # cache in the live compute dtype
+            )
+
+        from deepspeed_tpu.inference.generation import greedy_generate
 
         def apply_fn(params, tokens, rng):
             return module.apply(params, tokens, rngs={"dropout": rng}, train=False)
 
         if self._generate_jit is None:
             self._generate_jit = {}
-        self._rng, sub = jax.random.split(self._rng)
         return greedy_generate(
             apply_fn,
             self._params,
@@ -63,4 +99,7 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
             eos_token_id=eos_token_id,
             pad_token_id=pad_token_id,
             jit_cache=self._generate_jit,
+            temperature=temperature,
+            top_k=top_k,
+            top_p=top_p,
         )
