@@ -18,6 +18,7 @@ the training forward — guarded by the decode-vs-full-forward parity test
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Any, Dict, NamedTuple, Tuple
 
 import jax
@@ -161,7 +162,25 @@ def _forward_with_cache(cfg, params, tokens, cache: KVCache, start_pos):
     return logits[:, -1, :], KVCache(k=new_k, v=new_v)
 
 
-_decoder_cache: Dict[int, Tuple] = {}
+def _cfg_key(cfg) -> Tuple:
+    """Value-based cache key: ``id(cfg)`` could serve a stale compiled
+    program if a config object is garbage-collected and another allocated
+    at the recycled address."""
+    import dataclasses
+
+    try:
+        return (
+            type(cfg).__name__,
+            tuple(
+                (f.name, repr(getattr(cfg, f.name, None)))
+                for f in dataclasses.fields(cfg)
+            ),
+        )
+    except TypeError:
+        return (type(cfg).__name__, repr(cfg))
+
+
+_decoder_cache: Dict[Tuple, Tuple] = {}
 
 
 def build_decoder(cfg: TransformerConfig) -> Tuple[Any, Any]:
@@ -171,7 +190,7 @@ def build_decoder(cfg: TransformerConfig) -> Tuple[Any, Any]:
     ``decode_step(params, token, cache, pos)`` appends one token [B].
     Both donate the cache buffer (in-place workspace update).
     """
-    key = id(cfg)
+    key = _cfg_key(cfg)
     if key in _decoder_cache:
         return _decoder_cache[key]
 
@@ -191,7 +210,11 @@ def build_decoder(cfg: TransformerConfig) -> Tuple[Any, Any]:
     return prefill, decode_step
 
 
-_loop_cache: Dict[Tuple, Any] = {}
+# LRU-bounded: serving/rollout loops with varying prompt lengths would
+# otherwise retain one whole-loop executable per (lengths, sampling) bucket
+# for the process lifetime
+_loop_cache: "OrderedDict[Tuple, Any]" = OrderedDict()
+_LOOP_CACHE_MAX = 32
 
 
 def generate(
@@ -240,11 +263,13 @@ def generate(
         rng = jax.random.PRNGKey(0)
 
     key = (
-        id(cfg), B, prompt_len, max_new_tokens, eos_token_id,
+        _cfg_key(cfg), B, prompt_len, max_new_tokens, eos_token_id,
         float(temperature), int(top_k), float(top_p), int(pad_token_id),
         str(tokens.dtype), str(cache.k.dtype),
     )
     loop = _loop_cache.get(key)
+    if loop is not None:
+        _loop_cache.move_to_end(key)
     if loop is None:
         sample = functools.partial(
             sample_logits, temperature=temperature, top_k=top_k, top_p=top_p
@@ -286,6 +311,8 @@ def generate(
 
         loop = jax.jit(_loop, donate_argnums=(2, 4))
         _loop_cache[key] = loop
+        while len(_loop_cache) > _LOOP_CACHE_MAX:
+            _loop_cache.popitem(last=False)
 
     out0 = jnp.full((B, max_len), pad_token_id, tokens.dtype)
     out0 = jax.lax.dynamic_update_slice(out0, tokens, (0, 0))

@@ -286,6 +286,7 @@ class InferenceEngine:
         padded = np.full((B, L), pad_token_id, dtype=tokens.dtype)
         padded[:, :P] = tokens
         finished = np.zeros(B, dtype=bool)
+        cursor = P
         for cur in range(P, L):
             logits = np.asarray(
                 self._param_stream.eval_forward(jnp.asarray(padded), None)
@@ -296,10 +297,13 @@ class InferenceEngine:
                               temperature=temperature, top_k=top_k, top_p=top_p)
             ).astype(padded.dtype)
             if eos_token_id is not None:
-                nxt = np.where(finished, pad_token_id, nxt)
+                # finished rows keep emitting EOS — same padding contract as
+                # the in-HBM decode paths
+                nxt = np.where(finished, eos_token_id, nxt)
             padded[:, cur] = nxt
+            cursor = cur + 1
             if eos_token_id is not None:
                 finished |= nxt == eos_token_id
                 if finished.all():
                     break
-        return padded
+        return padded[:, :cursor]

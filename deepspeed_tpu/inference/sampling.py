@@ -21,26 +21,6 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
-def top_k_filter(logits: jnp.ndarray, k: int) -> jnp.ndarray:
-    """Mask everything below the k-th largest logit (per row)."""
-    if k <= 0:
-        return logits
-    k = min(k, logits.shape[-1])
-    kth = jnp.sort(logits, axis=-1)[..., -k][..., None]
-    return jnp.where(logits < kth, NEG_INF, logits)
-
-
-def top_p_filter(logits: jnp.ndarray, p: float) -> jnp.ndarray:
-    """Nucleus filter: keep the smallest prefix of the sorted distribution
-    whose cumulative probability reaches ``p`` (the top token always
-    survives, even when ``p`` is 0 or its probability alone exceeds it)."""
-    if p >= 1.0:
-        return logits
-    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
-    cutoff = _nucleus_cutoff(sorted_logits, p)
-    return jnp.where(logits < cutoff, NEG_INF, logits)
-
-
 def _nucleus_cutoff(sorted_desc: jnp.ndarray, p: float) -> jnp.ndarray:
     """Smallest logit inside the nucleus of a descending-sorted row."""
     probs = jax.nn.softmax(sorted_desc, axis=-1)
@@ -52,6 +32,36 @@ def _nucleus_cutoff(sorted_desc: jnp.ndarray, p: float) -> jnp.ndarray:
     return jnp.min(kept_logits, axis=-1, keepdims=True)
 
 
+def apply_filters(logits: jnp.ndarray, top_k: int = 0, top_p: float = 1.0) -> jnp.ndarray:
+    """Mask logits outside the top-k / nucleus (HF order: top-k first, then
+    top-p over the k-filtered distribution). The single shared
+    implementation — one O(V log V) sort serves both filters, which matters
+    because this runs inside the per-token decode loop."""
+    if top_k <= 0 and top_p >= 1.0:
+        return logits
+    sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+    if top_k > 0:
+        k = min(top_k, logits.shape[-1])
+        cutoff = sorted_desc[..., k - 1][..., None]
+        sorted_desc = jnp.where(sorted_desc < cutoff, NEG_INF, sorted_desc)
+    if top_p < 1.0:
+        # the nucleus cutoff is >= the kth value, so it subsumes top-k's
+        cutoff = _nucleus_cutoff(sorted_desc, top_p)
+    return jnp.where(logits < cutoff, NEG_INF, logits)
+
+
+def top_k_filter(logits: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Mask everything below the k-th largest logit (per row)."""
+    return apply_filters(logits, top_k=k)
+
+
+def top_p_filter(logits: jnp.ndarray, p: float) -> jnp.ndarray:
+    """Nucleus filter: keep the smallest prefix of the sorted distribution
+    whose cumulative probability reaches ``p`` (the top token always
+    survives, even when ``p`` is 0 or its probability alone exceeds it)."""
+    return apply_filters(logits, top_p=p)
+
+
 def sample_logits(
     logits: jnp.ndarray,  # [B, V]
     rng: Optional[jax.Array],
@@ -59,24 +69,10 @@ def sample_logits(
     top_k: int = 0,
     top_p: float = 1.0,
 ) -> jnp.ndarray:
-    """Next-token ids [B]. ``temperature == 0`` (or no rng) = greedy; the
-    filters compose the HF order: top-k, then top-p over the k-filtered
-    distribution, then categorical. One vocab sort serves both filters —
-    this runs inside the per-token decode loop, so the O(V log V) work is
-    not duplicated."""
+    """Next-token ids [B]. ``temperature == 0`` (or no rng) = greedy;
+    otherwise filter via ``apply_filters`` and draw categorically."""
     if temperature <= 0.0 or rng is None:
         return jnp.argmax(logits, axis=-1)
-    logits = logits / temperature
-    if top_k > 0 or top_p < 1.0:
-        sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
-        if top_k > 0:
-            k = min(top_k, logits.shape[-1])
-            kth = sorted_desc[..., k - 1][..., None]
-            sorted_desc = jnp.where(sorted_desc < kth, NEG_INF, sorted_desc)
-            cutoff = kth
-        if top_p < 1.0:
-            # nucleus over the (possibly k-filtered) distribution; its
-            # cutoff is >= the kth value, so it subsumes the top-k cutoff
-            cutoff = _nucleus_cutoff(sorted_desc, top_p)
-        logits = jnp.where(logits < cutoff, NEG_INF, logits)
-    return jax.random.categorical(rng, logits, axis=-1)
+    return jax.random.categorical(
+        rng, apply_filters(logits / temperature, top_k, top_p), axis=-1
+    )

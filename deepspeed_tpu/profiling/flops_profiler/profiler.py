@@ -90,6 +90,146 @@ def get_compiled_cost(jitted_fn, *args, **kwargs) -> Dict[str, float]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# per-module profile tree
+# ---------------------------------------------------------------------------
+class ModuleProfile:
+    """One node of the per-module tree (reference profiler.py:85-130 prints
+    this per hooked nn.Module; here nodes come from the model's streamable
+    decomposition — embed / layer_i / head — each compiled and cost-analyzed
+    as its own XLA program)."""
+
+    def __init__(self, name: str, depth: int, params: int, flops: float, latency: float):
+        self.name = name
+        self.depth = depth
+        self.params = params
+        self.flops = flops
+        self.macs = flops / 2
+        self.latency = latency
+        self.children: list = []
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "depth": self.depth,
+            "params": self.params,
+            "macs": self.macs,
+            "flops": self.flops,
+            "latency": self.latency,
+            "children": [c.as_dict() for c in self.children],
+        }
+
+
+def _tree_params(tree) -> int:
+    import jax
+
+    return sum(int(np.prod(np.shape(l))) for l in jax.tree_util.tree_leaves(tree))
+
+
+def _time_jitted(fn, *args, runs: int = 3) -> float:
+    import jax
+
+    out = fn(*args)  # compile + warm
+    jax.tree_util.tree_map(lambda x: getattr(x, "block_until_ready", lambda: x)(), out)
+    t0 = time.perf_counter()
+    for _ in range(runs):
+        out = fn(*args)
+    jax.tree_util.tree_map(lambda x: getattr(x, "block_until_ready", lambda: x)(), out)
+    return (time.perf_counter() - t0) / runs
+
+
+def get_module_profile(module, params, tokens, runs: int = 3) -> ModuleProfile:
+    """Per-module profile tree of a layer-streamable model.
+
+    The model's ``stream_fns`` decomposition (embed → layer × L → head,
+    ``models/transformer.py:467``) already names the module boundaries the
+    reference walks with hooks; each part is jitted separately so XLA's
+    ``cost_analysis`` gives its exact flops and a timed run gives real
+    per-module latency. Layers share one compiled program, so the per-layer
+    flops/latency are measured once and attributed to every layer row
+    (layer params are counted per layer from the stacked tree).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if not hasattr(module, "stream_fns"):
+        raise ValueError(
+            "per-module profiling needs a layer-streamable model exposing "
+            f"stream_fns(); got {type(module).__name__}"
+        )
+    embed_fwd, layer_fwd, head_loss = module.stream_fns()
+    tokens = jnp.asarray(tokens)
+    if tokens.ndim == 1:
+        tokens = tokens[None, :]
+    B, T = tokens.shape
+    resident = {k: v for k, v in params.items() if k != "layers"}
+    layers_stacked = params["layers"]
+    n_layers = int(jax.tree_util.tree_leaves(layers_stacked)[0].shape[0])
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
+    rng = jax.random.PRNGKey(0)
+
+    j_embed = jax.jit(embed_fwd)
+    j_layer = jax.jit(lambda p, h: layer_fwd(p, h, positions, rng, train=False))
+    j_head = jax.jit(lambda r, h: head_loss(r, h, None))
+
+    h = j_embed(resident, tokens)
+    layer0 = jax.tree_util.tree_map(lambda x: x[0], layers_stacked)
+
+    embed_cost = get_compiled_cost(j_embed, resident, tokens)["flops"]
+    layer_cost = get_compiled_cost(j_layer, layer0, h)["flops"]
+    head_cost = get_compiled_cost(j_head, resident, h)["flops"]
+    embed_lat = _time_jitted(j_embed, resident, tokens, runs=runs)
+    layer_lat = _time_jitted(j_layer, layer0, h, runs=runs)
+    head_lat = _time_jitted(j_head, resident, h, runs=runs)
+
+    embed_params = _tree_params(params.get("embed"))
+    head_params = _tree_params(resident) - embed_params
+
+    total_flops = embed_cost + n_layers * layer_cost + head_cost
+    total_lat = embed_lat + n_layers * layer_lat + head_lat
+    root = ModuleProfile(
+        type(module).__name__, 0, _tree_params(params), total_flops, total_lat
+    )
+    root.children.append(ModuleProfile("embed", 1, embed_params, embed_cost, embed_lat))
+    layers_node = ModuleProfile(
+        "layers", 1, _tree_params(layers_stacked), n_layers * layer_cost,
+        n_layers * layer_lat,
+    )
+    per_layer_params = _tree_params(layers_stacked) // max(n_layers, 1)
+    for i in range(n_layers):
+        layers_node.children.append(
+            ModuleProfile(f"layers.{i}", 2, per_layer_params, layer_cost, layer_lat)
+        )
+    root.children.append(layers_node)
+    root.children.append(ModuleProfile("head", 1, head_params, head_cost, head_lat))
+    return root
+
+
+def render_module_tree(root: ModuleProfile) -> str:
+    """The reference's per-module printout: depth-indented rows of
+    params, MACs, latency, and % of total (profiler.py:85-130)."""
+    lines = []
+
+    def pct(x, total):
+        return f"{100.0 * x / total:.2f}%" if total else "0.00%"
+
+    def walk(node: ModuleProfile):
+        indent = "  " * node.depth
+        lines.append(
+            f"{indent}{node.name}: "
+            f"{params_to_string(node.params)} params, "
+            f"{macs_to_string(node.macs)}, "
+            f"{duration_to_string(node.latency)}, "
+            f"{pct(node.flops, root.flops)} flops, "
+            f"{pct(node.latency, root.latency)} latency"
+        )
+        for c in node.children:
+            walk(c)
+
+    walk(root)
+    return "\n".join(lines)
+
+
 class FlopsProfiler:
     """Engine-attached profiler (reference profiler.py:28).
 
@@ -147,6 +287,25 @@ class FlopsProfiler:
             n = self.ds_engine.num_parameters()
         return params_to_string(n) if as_string else n
 
+    def get_module_profile(self) -> Optional[ModuleProfile]:
+        """Per-module tree for the engine's model (None when the engine is
+        absent, uninitialized, or its module is not layer-streamable)."""
+        e = self.ds_engine
+        if e is None or not getattr(e, "_initialized", False):
+            return None
+        module = getattr(e, "module", None)
+        if module is None or not hasattr(module, "stream_fns"):
+            return None
+        batch = getattr(e, "_last_batch", None)
+        if batch is None:
+            return None
+        tokens = batch.get("input_ids") if hasattr(batch, "get") else batch[0]
+        try:
+            return get_module_profile(module, e.get_params(), tokens)
+        except Exception as ex:  # best-effort, like the whole-program cost
+            logger.debug(f"per-module profile unavailable: {ex}")
+            return None
+
     def print_model_profile(self, profile_step=1, module_depth=-1, top_modules=1, detailed=True, output_file=None):  # noqa: ARG002
         flops = self.get_total_flops()
         latency = self.get_total_duration()
@@ -166,6 +325,12 @@ class FlopsProfiler:
             lines.append(
                 f"Peak compiled memory:                   {_num_to_string(self.cost['peak_memory_bytes'])}B"
             )
+        if detailed:
+            tree = self.get_module_profile()
+            if tree is not None:
+                lines.append("")
+                lines.append("Per-module profile (params, MACs, latency, % of total):")
+                lines.append(render_module_tree(tree))
         lines.append("-" * 79)
         text = "\n".join(lines)
         if output_file:

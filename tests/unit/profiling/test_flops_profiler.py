@@ -91,3 +91,76 @@ class TestActivationCheckpointing:
 
         out = CheckpointFunction.apply(lambda a, b: a + b, jnp.ones(3), jnp.ones(3))
         np.testing.assert_array_equal(np.asarray(out), np.full(3, 2.0))
+
+
+class TestModuleProfileTree:
+    """Per-module tree (reference profiler.py:85-130): depth-indented rows
+    with params/MACs/latency/% per module, layer-by-layer."""
+
+    def _model(self):
+        from deepspeed_tpu.models import TransformerLM
+        from deepspeed_tpu.models.config import TransformerConfig
+
+        cfg = TransformerConfig(
+            vocab_size=128,
+            hidden_size=32,
+            num_layers=4,
+            num_heads=4,
+            max_seq_len=32,
+            dtype="float32",
+            flash_attention=False,
+        )
+        model = TransformerLM(cfg)
+        toks = jnp.asarray(np.random.RandomState(0).randint(0, 128, (2, 16)), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), toks)
+        return model, params, toks
+
+    def test_per_layer_rows(self):
+        from deepspeed_tpu.profiling.flops_profiler.profiler import (
+            get_module_profile,
+            render_module_tree,
+        )
+
+        model, params, toks = self._model()
+        root = get_module_profile(model, params, toks, runs=1)
+        names = [c.name for c in root.children]
+        assert names == ["embed", "layers", "head"]
+        layer_rows = root.children[1].children
+        assert [r.name for r in layer_rows] == [f"layers.{i}" for i in range(4)]
+        assert all(r.macs > 0 and r.params > 0 and r.latency > 0 for r in layer_rows)
+        # totals are consistent: children sum to the root
+        child_flops = sum(c.flops for c in root.children)
+        assert abs(child_flops - root.flops) < 1e-6 * max(root.flops, 1)
+        text = render_module_tree(root)
+        assert "layers.3" in text and "MACs" in text and "%" in text
+
+    def test_engine_wired_tree_in_printout(self, capsys, eight_devices):
+        from deepspeed_tpu.models import TransformerLM
+        from deepspeed_tpu.models.config import TransformerConfig
+
+        mesh_mod.reset_topology()
+        cfg = TransformerConfig(
+            vocab_size=128, hidden_size=32, num_layers=4, num_heads=4,
+            max_seq_len=32, dtype="float32", flash_attention=False,
+        )
+        engine, *_ = ds.initialize(
+            model=TransformerLM(cfg),
+            config={
+                "train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 1},
+                "flops_profiler": {"enabled": True, "profile_step": 1},
+            },
+        )
+        toks = np.random.RandomState(0).randint(0, 128, (8, 17)).astype(np.int32)
+        batch = {"input_ids": toks[:, :-1], "labels": toks[:, 1:]}
+        for _ in range(2):
+            loss = engine(batch)
+            engine.backward(loss)
+            engine.step()
+        prof = engine.flops_profiler
+        tree = prof.get_module_profile()
+        assert tree is not None and len(tree.children[1].children) == 4
+        prof.print_model_profile(detailed=True)
+        out = capsys.readouterr().out
+        assert "layers.2" in out
