@@ -6,6 +6,7 @@ from deepspeed_tpu.checkpoint.deepspeed_checkpoint import (
     load_hp_checkpoint_state,
     universal_param_names,
 )
+from deepspeed_tpu.checkpoint.reference_export import export_reference_checkpoint
 from deepspeed_tpu.checkpoint.reference_ingest import (
     ingest_reference_checkpoint,
     merge_reference_model_states,

@@ -1,0 +1,134 @@
+"""Export training state in the reference's checkpoint layout.
+
+The inverse of ``reference_ingest.py``: write the torch-pickle file family
+the reference emits for a ZeRO stage-1/2 run (``deepspeed/runtime/engine.py:
+2588`` ``_save_checkpoint`` + ``:2961`` ``_save_zero_checkpoint``), so a
+deepspeed_tpu run can round-trip back into the reference ecosystem — its
+``zero_to_fp32.py`` consolidation script consumes exactly these files:
+
+* ``{tag}/mp_rank_00_model_states.pt`` — ``module`` weights (compute
+  dtype), plus the bookkeeping zero_to_fp32 requires: ``param_shapes``
+  (per-group name → ``torch.Size``), ``buffer_names``, ``shared_params``,
+  ``ds_version``, ``iteration``;
+* ``{tag}/zero_pp_rank_{dp}_mp_rank_00_optim_states.pt`` — each dp rank's
+  flat fp32 master partition under ``optimizer_state_dict`` with
+  ``zero_stage`` / ``partition_count`` / ``single_partition_of_fp32_groups``
+  (the keys ``zero_to_fp32.py:parse_optim_states`` reads);
+* ``latest`` — the tag pointer.
+
+Tensor names are the TPU model's flat tree paths (stacked ``layers/...``
+arrays stay stacked): both the reference consolidation script and our own
+``reference_ingest`` treat names as opaque strings, so the round-trip is
+exact. TP export is always mp_rank_00 — global arrays are already merged;
+a reference run wanting TP shards re-shards at load time.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import log_dist
+
+
+def export_reference_checkpoint(
+    engine,
+    save_dir: str,
+    tag: Optional[str] = None,
+    dp_shards: Optional[int] = None,
+) -> str:
+    """Write ``engine``'s weights + fp32 masters in the reference layout.
+
+    ``dp_shards`` controls how many ``zero_pp_rank_*`` files the flat fp32
+    masters are split across (default: the engine's data-parallel world
+    size, matching what a same-size reference run would have written).
+    Returns the tag directory path.
+    """
+    import torch
+
+    from deepspeed_tpu import comm as dist
+    from deepspeed_tpu.utils.tensor_fragment import _flatten_with_paths
+
+    if not getattr(engine, "_initialized", False):
+        raise RuntimeError("cannot export before the engine state is initialized")
+    if tag is None:
+        tag = f"global_step{engine.global_steps}"
+    if dp_shards is None:
+        dp_shards = max(int(engine.data_parallel_world_size()), 1)
+
+    import jax
+
+    # Consolidation runs on EVERY process (device_get of dp-sharded global
+    # arrays needs all participants, like save_16bit_model); only the file
+    # writes below are rank-gated.
+    masters = {
+        name: np.asarray(jax.device_get(leaf), np.float32)
+        for name, leaf in _flatten_with_paths(engine.get_master_params()).items()
+    }
+    module_sd = engine.consolidated_16bit_state_dict()
+
+    path = os.path.join(save_dir, tag)
+    if dist.get_rank() != 0:
+        return path
+    os.makedirs(path, exist_ok=True)
+
+    names = list(masters.keys())  # _flatten_with_paths order (sorted paths)
+    param_shapes = OrderedDict(
+        (name, torch.Size(masters[name].shape)) for name in names
+    )
+    flat = np.concatenate([masters[n].ravel() for n in names]) if names else np.zeros(0, np.float32)
+    pad = (-flat.size) % dp_shards
+    flat = np.pad(flat, (0, pad))  # dp-divisibility padding, like the
+    # reference's flat-buffer alignment; consolidation ignores the tail
+    partitions = np.split(flat, dp_shards)
+
+    def _to_torch(v: np.ndarray) -> "torch.Tensor":
+        """Preserve the compute dtype (the reference's model_states carry
+        bf16/fp16 weights); numpy's extension bf16 routes through fp32."""
+        if v.dtype.name == "bfloat16":
+            return torch.from_numpy(
+                np.ascontiguousarray(v.astype(np.float32))
+            ).to(torch.bfloat16)
+        return torch.from_numpy(np.ascontiguousarray(v))
+
+    zero_stage = min(int(getattr(engine, "zero_optimization_stage", lambda: 1)()), 2)
+    model_state = {
+        "module": {k: _to_torch(np.asarray(v)) for k, v in module_sd.items()},
+        "buffer_names": [],
+        "shared_params": {},
+        "param_shapes": [param_shapes],
+        "dp_world_size": dp_shards,
+        "mp_world_size": 1,
+        "iteration": int(engine.global_steps),
+        "global_steps": int(engine.global_steps),
+        "ds_version": "0.10.2+tpu",
+    }
+    torch.save(model_state, os.path.join(path, "mp_rank_00_model_states.pt"))
+
+    for dp, part in enumerate(partitions):
+        optim_state = {
+            "optimizer_state_dict": {
+                "zero_stage": zero_stage,
+                "partition_count": dp_shards,
+                "single_partition_of_fp32_groups": [
+                    torch.from_numpy(np.ascontiguousarray(part))
+                ],
+                "ds_version": "0.10.2+tpu",
+            }
+        }
+        torch.save(
+            optim_state,
+            os.path.join(path, f"zero_pp_rank_{dp}_mp_rank_00_optim_states.pt"),
+        )
+
+    with open(os.path.join(save_dir, "latest"), "w") as f:
+        f.write(tag)
+    log_dist(
+        f"exported reference-layout checkpoint: {path} "
+        f"({len(names)} tensors, dp_shards={dp_shards})",
+        ranks=[0],
+    )
+    return path

@@ -1402,6 +1402,20 @@ class DeepSpeedEngine:
             for name, leaf in _flatten_with_paths(params).items()
         }
 
+    def save_reference_checkpoint(self, save_dir: str, tag: Optional[str] = None, dp_shards: Optional[int] = None) -> str:
+        """Write the reference's sharded training-checkpoint layout
+        (mp_rank_00_model_states.pt + zero_pp_rank_*_optim_states.pt +
+        latest) so the reference's own ``zero_to_fp32.py`` can consolidate
+        this run (reference ``_save_checkpoint``/``_save_zero_checkpoint``,
+        engine.py:2588,2961). See ``checkpoint/reference_export.py``."""
+        from deepspeed_tpu.checkpoint.reference_export import export_reference_checkpoint
+
+        # all ranks consolidate (the exporter rank-gates the file writes),
+        # and all ranks return the same deterministic path
+        path = export_reference_checkpoint(self, save_dir, tag=tag, dp_shards=dp_shards)
+        dist.barrier(name="save_reference_checkpoint")
+        return path
+
     def save_16bit_model(self, save_dir: str, save_filename: str = "pytorch_model.bin", exclude_frozen_parameters: bool = False):  # noqa: ARG002
         """Write ONE consolidated compute-dtype weights file loadable without
         the engine (reference ``save_16bit_model``, engine.py:3442).
