@@ -39,6 +39,11 @@ class TransformerConfig:
     qkv_bias: Optional[bool] = None  # override for qkv projs
     dtype: str = "bfloat16"  # computation dtype for activations
 
+    # sparse embedding gradients (reference engine.py:2398: DP-reduce the
+    # compact (ids, rows) pairs instead of the dense table; requires an
+    # untied table — a tied LM head makes the table grad dense anyway)
+    sparse_embedding_grads: bool = False
+
     # engineering knobs
     remat: bool = True  # jax.checkpoint each layer
     remat_policy: str = "nothing_saveable"
@@ -64,6 +69,12 @@ class TransformerConfig:
             raise ValueError(
                 f"unknown sequence_parallel_mode {self.sequence_parallel_mode!r}; "
                 "expected 'ulysses' or 'ring'"
+            )
+        if self.sparse_embedding_grads and self.tie_embeddings:
+            raise ValueError(
+                "sparse_embedding_grads requires tie_embeddings=False: a tied "
+                "LM head contributes a dense gradient to the same table, so "
+                "there is nothing sparse to reduce"
             )
 
 

@@ -108,16 +108,43 @@ class DeepSpeedEngine:
         self.mpu = mpu
 
         self._config = config_class or DeepSpeedConfig(config if config is not None else {}, mpu)
-        if self._config.sparse_gradients_enabled:
-            # reference engine.py:2398 sparsifies embedding grads for the
-            # allreduce; under XLA embedding grads are dense scatter-adds and
-            # the reduction already rides reduce-scatter shardings, so the
-            # flag cannot do what it promises — reject rather than ignore
-            raise NotImplementedError(
-                "sparse_gradients is not supported by the TPU engine (XLA "
-                "embedding gradients are dense and already reduce-scattered); "
-                "remove the key"
-            )
+        # Sparse embedding gradients (reference engine.py:2398: embedding
+        # grads reduced as compact (ids, rows) pairs). The model family's
+        # lookup switches to ``sparse_embedding_lookup``
+        # (runtime/sparse_tensor.py) whose custom VJP all-gathers pairs
+        # inside a shard_map — requires ZeRO ≤ 1 (a stage-2/3 grad
+        # reduce-scatter would re-shard the dense table grad, defeating the
+        # compact reduction; the reference's sparse paths are stage-1-only
+        # too). The gate guards the MECHANISM: it fires whether the request
+        # came from the JSON key or from a model config built with
+        # ``sparse_embedding_grads=True`` directly.
+        mcfg = getattr(self.module, "config", None)
+        model_flag = bool(getattr(mcfg, "sparse_embedding_grads", False))
+        if self._config.sparse_gradients_enabled or model_flag:
+            if int(self._config.zero_optimization_stage) > 1:
+                raise ValueError(
+                    "sparse_gradients requires ZeRO stage <= 1 (the compact "
+                    "pair reduction replaces the dense grad reduce-scatter)"
+                )
+        if self._config.sparse_gradients_enabled and not model_flag:
+            if mcfg is not None and hasattr(mcfg, "sparse_embedding_grads"):
+                if getattr(mcfg, "tie_embeddings", False):
+                    raise ValueError(
+                        "sparse_gradients requires an untied embedding table "
+                        "(set tie_embeddings=False): a tied LM head makes the "
+                        "table gradient dense"
+                    )
+                # wire the engine-level key into the family switch (documented
+                # side effect — the reference's engine likewise rewrites how
+                # embedding grads are produced when the key is set)
+                mcfg.sparse_embedding_grads = True
+            elif not getattr(self.module, "supports_sparse_gradients", False):
+                raise NotImplementedError(
+                    "sparse_gradients: this module family has no sparse "
+                    "embedding switch (TransformerLM exposes "
+                    "config.sparse_embedding_grads); remove the key or use a "
+                    "family that supports it"
+                )
         self._apply_mics_mesh()
         self._validate_zeropp_config()
         self.topology: Topology = get_topology() if _topology_matches(self._config) else initialize_topology(
@@ -1410,11 +1437,9 @@ class DeepSpeedEngine:
         engine.py:2588,2961). See ``checkpoint/reference_export.py``."""
         from deepspeed_tpu.checkpoint.reference_export import export_reference_checkpoint
 
-        # all ranks consolidate (the exporter rank-gates the file writes),
-        # and all ranks return the same deterministic path
-        path = export_reference_checkpoint(self, save_dir, tag=tag, dp_shards=dp_shards)
-        dist.barrier(name="save_reference_checkpoint")
-        return path
+        # all ranks consolidate (the exporter rank-gates the file writes and
+        # barriers before returning), and all return the same path
+        return export_reference_checkpoint(self, save_dir, tag=tag, dp_shards=dp_shards)
 
     def save_16bit_model(self, save_dir: str, save_filename: str = "pytorch_model.bin", exclude_frozen_parameters: bool = False):  # noqa: ARG002
         """Write ONE consolidated compute-dtype weights file loadable without

@@ -1,0 +1,153 @@
+"""Sparse embedding gradients (reference engine.py:2398-2465 +
+runtime/sparse_tensor.py:68): math parity with the dense path, the compact
+pair collective in the compiled program, and the engine's validation gates."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+import deepspeed_tpu.parallel.mesh as mesh_mod
+from deepspeed_tpu.models import TransformerLM, llama_config
+from deepspeed_tpu.runtime.sparse_tensor import SparseTensor, sparse_embedding_lookup
+
+VOCAB, HIDDEN = 512, 64
+
+
+class TestSparseTensor:
+    def test_roundtrip(self):
+        dense = np.zeros((16, 4), np.float32)
+        dense[3] = 1.5
+        dense[11] = -2.0
+        st = SparseTensor.from_dense(jnp.asarray(dense))
+        assert st.sparse_size() < dense.size
+        np.testing.assert_array_equal(np.asarray(st.to_dense()), dense)
+
+
+class TestSparseLookupMath:
+    def test_grad_matches_dense_single_shard(self):
+        rs = np.random.RandomState(0)
+        table = jnp.asarray(rs.randn(VOCAB, HIDDEN).astype(np.float32))
+        tokens = jnp.asarray(rs.randint(0, VOCAB, (4, 16)).astype(np.int32))
+        w = jnp.asarray(rs.randn(HIDDEN).astype(np.float32))
+
+        def loss_sparse(t):
+            return jnp.sum(sparse_embedding_lookup(t, tokens, None) * w)
+
+        def loss_dense(t):
+            return jnp.sum(t[tokens] * w)
+
+        gs = jax.grad(loss_sparse)(table)
+        gd = jax.grad(loss_dense)(table)
+        np.testing.assert_allclose(np.asarray(gs), np.asarray(gd), rtol=1e-6)
+
+    def test_grad_matches_dense_dp8(self, eight_devices):
+        """Sharded batch over data=8: the shard_map pair-gather reduction
+        must equal the dense psum reduction."""
+        from deepspeed_tpu.parallel.mesh import MeshConfig
+
+        mesh_mod.reset_topology()
+        topo = mesh_mod.initialize_topology(MeshConfig(data=8))
+        rs = np.random.RandomState(1)
+        table = jnp.asarray(rs.randn(VOCAB, HIDDEN).astype(np.float32))
+        tokens_np = rs.randint(0, VOCAB, (8, 16)).astype(np.int32)
+        w = jnp.asarray(rs.randn(HIDDEN).astype(np.float32))
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        tokens = jax.device_put(tokens_np, NamedSharding(topo.mesh, P("data", None)))
+
+        @jax.jit
+        def g_sparse(t):
+            return jax.grad(lambda tt: jnp.sum(sparse_embedding_lookup(tt, tokens, ("data",)) * w))(t)
+
+        @jax.jit
+        def g_dense(t):
+            return jax.grad(lambda tt: jnp.sum(tt[tokens] * w))(t)
+
+        np.testing.assert_allclose(
+            np.asarray(g_sparse(table)), np.asarray(g_dense(table)), rtol=1e-6
+        )
+
+
+class TestEngineSparseGradients:
+    def _config(self, stage=1):
+        return {
+            "train_micro_batch_size_per_gpu": 1,
+            "sparse_gradients": True,
+            "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": stage},
+            "mesh": {"data": 8},
+            "steps_per_print": 10_000,
+        }
+
+    def _model(self, **over):
+        cfg = llama_config(
+            "tiny", num_layers=2, max_seq_len=32, vocab_size=VOCAB, **over
+        )
+        return TransformerLM(cfg)
+
+    def test_trains_and_matches_dense(self, eight_devices):
+        rs = np.random.RandomState(2)
+        toks = rs.randint(0, VOCAB, (8, 33)).astype(np.int32)
+        batch = {"input_ids": toks[:, :-1], "labels": toks[:, 1:]}
+        finals = []
+        for sparse in (True, False):
+            mesh_mod.reset_topology()
+            cfg = dict(self._config())
+            if not sparse:
+                cfg.pop("sparse_gradients")
+            engine, _, _, _ = ds.initialize(
+                model=self._model(), config=cfg, dist_init_required=False
+            )
+            for _ in range(3):
+                loss = engine(batch)
+                engine.backward(loss)
+                engine.step()
+            finals.append(
+                (
+                    float(jax.device_get(loss)),
+                    np.asarray(jax.device_get(engine.get_params()["embed"]["tokens"])),
+                )
+            )
+        # the pair-gather scatter-adds in a different order than the dense
+        # psum; fp32 rounding noise passes through Adam's sign-like early
+        # updates, so per-element drift is bounded by ~a few lr — exact grad
+        # equality is asserted at dp8 in test_grad_matches_dense_dp8
+        assert abs(finals[0][0] - finals[1][0]) < 5e-3
+        np.testing.assert_allclose(finals[0][1], finals[1][1], rtol=2e-2, atol=5e-3)
+
+    def test_pair_gather_in_compiled_program(self, eight_devices):
+        """The sparse path's compiled step carries the compact pair
+        all-gather; the dense table is never all-reduced."""
+        mesh_mod.reset_topology()
+        engine, _, _, _ = ds.initialize(
+            model=self._model(), config=self._config(), dist_init_required=False
+        )
+        rs = np.random.RandomState(3)
+        toks = rs.randint(0, VOCAB, (8, 33)).astype(np.int32)
+        batch = {"input_ids": toks[:, :-1], "labels": toks[:, 1:]}
+        loss = engine(batch)
+        engine.backward(loss)
+        engine.step()
+        placed = engine._place_batch(batch)
+        lr = engine.optimizer.param_groups[0]["lr"]
+        args = (engine._master, engine._opt_state, engine._scale_state, lr, engine._rng, placed)
+        txt = engine._jit_fused_step.lower(*args).compile().as_text()
+        assert "all-gather" in txt
+
+    def test_stage2_rejected(self):
+        mesh_mod.reset_topology()
+        with pytest.raises(ValueError, match="stage <= 1"):
+            ds.initialize(
+                model=self._model(), config=self._config(stage=2), dist_init_required=False
+            )
+
+    def test_tied_embeddings_rejected(self):
+        mesh_mod.reset_topology()
+        with pytest.raises(ValueError, match="untied"):
+            ds.initialize(
+                model=self._model(tie_embeddings=True),
+                config=self._config(),
+                dist_init_required=False,
+            )
