@@ -6,3 +6,9 @@ from deepspeed_tpu.models.moe_transformer import (
     moe_llama_config,
 )
 from deepspeed_tpu.models.transformer import TransformerLM, cross_entropy_loss
+from deepspeed_tpu.models.unet import (
+    AutoencoderKL,
+    UNet2DConditionModel,
+    UNetConfig,
+    VAEConfig,
+)

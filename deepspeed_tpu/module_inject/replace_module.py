@@ -87,8 +87,30 @@ def replace_transformer_layer(
 
 
 def generic_injection(model, dtype=None, enable_cuda_graph=False):  # noqa: ARG001
-    """Diffusers-style generic injection (reference replace_module.py:86) —
-    not applicable on the decoder path; retained for API parity."""
+    """Diffusers-style generic injection (reference replace_module.py:86).
+
+    The reference walks a diffusers pipeline's UNet/VAE and swaps attention
+    blocks for DS kernels; the TPU counterpart wraps the spatial model
+    families (``models/unet.py``) in an ``InferenceEngine`` so their
+    ``tp_partition_rules`` sharding specs are applied and the forward is
+    jitted (XLA supplies the fused bias-add the reference hand-writes in
+    ``csrc/spatial/csrc/opt_bias_add.cu``). Non-spatial modules pass through
+    unchanged, mirroring the reference's policy-miss behavior."""
+    from deepspeed_tpu.models.unet import AutoencoderKL, UNet2DConditionModel
+
+    if isinstance(model, (UNet2DConditionModel, AutoencoderKL)):
+        import deepspeed_tpu as ds
+
+        s = str(dtype) if dtype is not None else "fp32"
+        if "bfloat16" in s or s == "bf16":
+            dt = "bf16"
+        elif "float16" in s or s in ("fp16", "half"):
+            dt = "fp16"
+        elif "int8" in s:
+            dt = "int8"
+        else:
+            dt = "fp32"
+        return ds.init_inference(model, dtype=dt)
     return model
 
 
