@@ -72,6 +72,9 @@ def export_reference_checkpoint(
 
     path = os.path.join(save_dir, tag)
     if dist.get_rank() != 0:
+        # writes are rank-0-only; every rank leaves together so no caller
+        # reads the tag dir before it is complete
+        dist.barrier(name="export_reference_checkpoint")
         return path
     os.makedirs(path, exist_ok=True)
 
@@ -131,4 +134,6 @@ def export_reference_checkpoint(
         f"({len(names)} tensors, dp_shards={dp_shards})",
         ranks=[0],
     )
+    dist.barrier(name="export_reference_checkpoint")  # pairs with the
+    # non-rank-0 barrier above: all ranks leave after the files exist
     return path

@@ -1,0 +1,147 @@
+"""Cross-ecosystem checkpoint interop against the REFERENCE's own machinery.
+
+Everything else in this directory validates our ingest/export against our
+own readers. Here the artifact we export is consumed by the reference's
+``deepspeed/checkpoint`` package itself (loaded standalone from
+``/root/reference`` — it only needs torch + relative imports), proving the
+round trip into the reference ecosystem:
+
+* ``reshape_utils.get_zero_files`` / ``merge_state`` consolidate our
+  ``zero_pp_rank_*`` fp32 shards exactly like ``zero_to_fp32.py`` would;
+* the merged flat buffer slices back into bitwise-equal fp32 masters using
+  the ``param_shapes`` recorded in our ``mp_rank_00_model_states.pt``.
+
+Skips when the reference tree is not present (end-user installs).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+import numpy as np
+import pytest
+import torch
+
+import deepspeed_tpu as ds
+import deepspeed_tpu.parallel.mesh as mesh_mod
+from deepspeed_tpu.utils.tensor_fragment import _flatten_with_paths
+from tests.unit.simple_model import SimpleModel, random_dataloader
+
+REF_CKPT_DIR = "/root/reference/deepspeed/checkpoint"
+HIDDEN = 16
+
+
+def _load_reference_checkpoint_pkg():
+    if not os.path.isdir(REF_CKPT_DIR):
+        pytest.skip("reference tree not available")
+    if "refckpt" in sys.modules:
+        return sys.modules["refckpt"]
+    spec = importlib.util.spec_from_file_location(
+        "refckpt",
+        os.path.join(REF_CKPT_DIR, "__init__.py"),
+        submodule_search_locations=[REF_CKPT_DIR],
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["refckpt"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _trained_engine():
+    mesh_mod.reset_topology()
+    engine, *_ = ds.initialize(
+        model=SimpleModel(HIDDEN),
+        config={
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 1},
+        },
+    )
+    for batch in random_dataloader(HIDDEN, total_samples=16, batch_size=8):
+        loss = engine(batch)
+        engine.backward(loss)
+        engine.step()
+    return engine
+
+
+def test_reference_machinery_consolidates_our_export(tmp_path, eight_devices):
+    refckpt = _load_reference_checkpoint_pkg()
+    from refckpt.reshape_utils import get_zero_files  # type: ignore
+
+    engine = _trained_engine()
+    root = str(tmp_path / "ref_out")
+    tag_dir = engine.save_reference_checkpoint(root, dp_shards=2)
+
+    # 1. the reference's zero-file discovery finds our shards
+    zero_files = get_zero_files(tag_dir)
+    assert len(zero_files) == 2, zero_files
+
+    # 2. the reference's merge_state concatenates the dp shards (dim 0),
+    #    exactly the consolidation zero_to_fp32.py performs
+    states = [
+        torch.load(f, map_location="cpu", weights_only=False)["optimizer_state_dict"]
+        for f in sorted(zero_files)
+    ]
+    merged = refckpt.merge_state(
+        states[0]["single_partition_of_fp32_groups"],
+        states[1]["single_partition_of_fp32_groups"],
+    )
+    flat = merged[0].numpy()
+
+    # 3. slice by the param_shapes our model_states file records → the
+    #    engine's live fp32 masters, bitwise
+    model_state = torch.load(
+        os.path.join(tag_dir, "mp_rank_00_model_states.pt"),
+        map_location="cpu",
+        weights_only=False,
+    )
+    (param_shapes,) = model_state["param_shapes"]
+    masters = {
+        k: np.asarray(v, np.float32)
+        for k, v in _flatten_with_paths(engine.get_master_params()).items()
+    }
+    offset = 0
+    for name, shape in param_shapes.items():
+        n = int(np.prod(shape)) if len(shape) else 1
+        got = flat[offset : offset + n].reshape(tuple(shape))
+        np.testing.assert_array_equal(got, masters[name], err_msg=name)
+        offset += n
+
+
+def test_reference_merge_matches_ours(tmp_path, eight_devices):
+    """Same artifact, two consolidators: the reference's merge_state and our
+    merge_reference_zero_fp32 must produce identical fp32 tensors."""
+    refckpt = _load_reference_checkpoint_pkg()
+    from deepspeed_tpu.checkpoint import merge_reference_zero_fp32
+
+    engine = _trained_engine()
+    root = str(tmp_path / "ref_out")
+    tag_dir = engine.save_reference_checkpoint(root, dp_shards=2)
+
+    ours = merge_reference_zero_fp32(root, "megatron_gpt")
+
+    from refckpt.reshape_utils import get_zero_files  # type: ignore
+
+    states = [
+        torch.load(f, map_location="cpu", weights_only=False)["optimizer_state_dict"]
+        for f in sorted(get_zero_files(tag_dir))
+    ]
+    merged = refckpt.merge_state(
+        states[0]["single_partition_of_fp32_groups"],
+        states[1]["single_partition_of_fp32_groups"],
+    )[0].numpy()
+    model_state = torch.load(
+        os.path.join(tag_dir, "mp_rank_00_model_states.pt"),
+        map_location="cpu",
+        weights_only=False,
+    )
+    (param_shapes,) = model_state["param_shapes"]
+    offset = 0
+    for name, shape in param_shapes.items():
+        n = int(np.prod(shape)) if len(shape) else 1
+        theirs = merged[offset : offset + n].reshape(tuple(shape))
+        np.testing.assert_array_equal(theirs, ours[name], err_msg=name)
+        offset += n
