@@ -28,6 +28,8 @@ import numpy as np
 from deepspeed_tpu.models.config import TransformerConfig
 from deepspeed_tpu.models.transformer import _norm, _rope
 
+NEG_INF_F = -1e30  # additive mask for dead beams (finite: keeps fp math NaN-free)
+
 
 class KVCache(NamedTuple):
     """Preallocated decode workspace (reference allocate_workspace)."""
@@ -217,6 +219,19 @@ _loop_cache: "OrderedDict[Tuple, Any]" = OrderedDict()
 _LOOP_CACHE_MAX = 32
 
 
+def _loop_cache_get(key):
+    loop = _loop_cache.get(key)
+    if loop is not None:
+        _loop_cache.move_to_end(key)
+    return loop
+
+
+def _loop_cache_put(key, loop):
+    _loop_cache[key] = loop
+    while len(_loop_cache) > _LOOP_CACHE_MAX:
+        _loop_cache.popitem(last=False)
+
+
 def generate(
     cfg: TransformerConfig,
     params,
@@ -267,9 +282,7 @@ def generate(
         float(temperature), int(top_k), float(top_p), int(pad_token_id),
         str(tokens.dtype), str(cache.k.dtype),
     )
-    loop = _loop_cache.get(key)
-    if loop is not None:
-        _loop_cache.move_to_end(key)
+    loop = _loop_cache_get(key)
     if loop is None:
         sample = functools.partial(
             sample_logits, temperature=temperature, top_k=top_k, top_p=top_p
@@ -310,11 +323,158 @@ def generate(
             return out, step, cache
 
         loop = jax.jit(_loop, donate_argnums=(2, 4))
-        _loop_cache[key] = loop
-        while len(_loop_cache) > _LOOP_CACHE_MAX:
-            _loop_cache.popitem(last=False)
+        _loop_cache_put(key, loop)
 
     out0 = jnp.full((B, max_len), pad_token_id, tokens.dtype)
     out0 = jax.lax.dynamic_update_slice(out0, tokens, (0, 0))
     out, n_emitted, _ = loop(params, logits, cache, rng, out0)
+    return out[:, : prompt_len + int(jax.device_get(n_emitted))]
+
+
+def beam_generate(
+    cfg: TransformerConfig,
+    params,
+    input_ids,
+    max_new_tokens: int,
+    num_beams: int = 4,
+    eos_token_id=None,
+    pad_token_id: int = 0,
+    length_penalty: float = 1.0,
+    dtype=None,
+):
+    """KV-cached beam search as ONE jitted decode loop.
+
+    The reference reaches beam search by delegating to HF ``generate``
+    (``deepspeed/inference/engine.py:578``), which re-orders its past-KV
+    tuples on the host every step. Here beams are a device-side batch
+    dimension: the prompt prefills ONCE at batch B, the cache is tiled to
+    B*K rows on the host side of the loop (so the loop can donate and alias
+    it), and each step's beam reorder is a gather over the cache's batch
+    axis INSIDE the compiled ``lax.while_loop`` — no host round-trips until
+    the final fetch.
+
+    Hypothesis bookkeeping follows HF's BeamSearchScorer semantics: a beam
+    that emits EOS is recorded into a per-row best-finished register (score
+    = cum_logprob / emitted**length_penalty) and leaves the active set (its
+    cum drops to -inf), freeing its slot for live continuations; the final
+    answer is the better of the best finished hypothesis and the best live
+    beam. First-expansion dedup uses the standard trick: beam 0 starts at
+    cum 0 and the rest at -inf, so the first top-K draws K distinct tokens.
+    Returns [B, prompt_len + emitted].
+    """
+    K = int(num_beams)
+    tokens = jnp.asarray(input_ids)
+    if tokens.ndim == 1:
+        tokens = tokens[None, :]
+    B, prompt_len = tokens.shape
+    max_len = prompt_len + max_new_tokens
+    V = cfg.vocab_size
+
+    cache = init_cache(cfg, B, max_len, dtype=dtype)
+    prefill, _ = build_decoder(cfg)
+    logits, cache = prefill(params, tokens, cache)  # [B, V]
+
+    # tile to B*K OUTSIDE the loop: the loop's donated cache/out buffers are
+    # then exactly the arrays it carries, so XLA aliases them in place
+    cache = KVCache(k=jnp.repeat(cache.k, K, axis=1), v=jnp.repeat(cache.v, K, axis=1))
+    out0 = jnp.full((B * K, max_len), pad_token_id, tokens.dtype)
+    out0 = jax.lax.dynamic_update_slice(out0, jnp.repeat(tokens, K, axis=0), (0, 0))
+    logits = jnp.repeat(logits, K, axis=0)
+
+    key = (
+        "beam", _cfg_key(cfg), B, K, prompt_len, max_new_tokens,
+        eos_token_id, int(pad_token_id), float(length_penalty),
+        str(tokens.dtype), str(cache.k.dtype),
+    )
+    loop = _loop_cache_get(key)
+    if loop is None:
+
+        def _norm_score(cum, emitted):
+            denom = jnp.maximum(emitted, 1).astype(jnp.float32) ** length_penalty
+            return cum / denom
+
+        def _loop(params, logits, cache, out):
+            cum0 = jnp.full((B, K), NEG_INF_F, jnp.float32).at[:, 0].set(0.0)
+
+            def cond(c):
+                step, finished = c[0], c[5]
+                return jnp.logical_and(
+                    step < max_new_tokens, jnp.logical_not(jnp.all(finished))
+                )
+
+            def body(c):
+                (step, logits, cache, out, cum, finished, emitted,
+                 best_score, best_out, best_len) = c
+                logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+                total = cum[:, :, None] + logp.reshape(B, K, V)  # [B, K, V]
+                new_cum, flat_idx = jax.lax.top_k(total.reshape(B, K * V), K)
+                beam_src = flat_idx // V  # [B, K] index into old beams
+                tok = (flat_idx % V).astype(out.dtype)  # [B, K]
+
+                # reorder every per-beam carry by beam_src
+                flat_src = (
+                    beam_src + jnp.arange(B, dtype=beam_src.dtype)[:, None] * K
+                ).reshape(B * K)
+                out = jnp.take(out, flat_src, axis=0)
+                cache = KVCache(
+                    k=jnp.take(cache.k, flat_src, axis=1),
+                    v=jnp.take(cache.v, flat_src, axis=1),
+                )
+                emitted = jnp.take(emitted.reshape(B * K), flat_src).reshape(B, K)
+
+                flat_tok = tok.reshape(B * K)
+                out = jax.lax.dynamic_update_slice(
+                    out, flat_tok[:, None], (0, prompt_len + step)
+                )
+                emitted = emitted + 1
+                if eos_token_id is not None:
+                    just_done = tok == eos_token_id  # [B, K]
+                    # record the best just-finished hypothesis per row, then
+                    # retire those beams (cum -> -inf frees their slots)
+                    cand = jnp.where(
+                        just_done, _norm_score(new_cum, emitted), NEG_INF_F
+                    )
+                    k_best = jnp.argmax(cand, axis=1)  # [B]
+                    row_score = jnp.take_along_axis(cand, k_best[:, None], 1)[:, 0]
+                    rows = jnp.arange(B, dtype=k_best.dtype)
+                    cand_out = jnp.take(out, rows * K + k_best, axis=0)
+                    cand_len = jnp.take_along_axis(emitted, k_best[:, None], 1)[:, 0]
+                    better = row_score > best_score
+                    best_out = jnp.where(better[:, None], cand_out, best_out)
+                    best_score = jnp.where(better, row_score, best_score)
+                    best_len = jnp.where(better, cand_len, best_len)
+                    new_cum = jnp.where(just_done, NEG_INF_F, new_cum)
+                    finished = new_cum <= NEG_INF_F / 2  # all slots dead?
+                logits, cache = _forward_with_cache(
+                    cfg, params, flat_tok[:, None], cache, prompt_len + step
+                )
+                return (step + 1, logits, cache, out, new_cum, finished,
+                        emitted, best_score, best_out, best_len)
+
+            state = (
+                jnp.int32(0), logits, cache, out, cum0,
+                jnp.zeros((B, K), bool),                 # finished (slot dead)
+                jnp.zeros((B, K), jnp.int32),            # emitted per live beam
+                jnp.full((B,), NEG_INF_F, jnp.float32),  # best finished score
+                out[::K].copy() if K > 1 else out.copy(),  # best finished seq
+                jnp.zeros((B,), jnp.int32),              # its emitted length
+            )
+            (step, _, cache, out, cum, _, emitted,
+             best_score, best_out, best_len) = jax.lax.while_loop(cond, body, state)
+            # better of: best finished hypothesis vs best live beam
+            live = _norm_score(cum, emitted)  # retired slots are -inf
+            k_live = jnp.argmax(live, axis=1)
+            rows = jnp.arange(B, dtype=k_live.dtype)
+            live_out = jnp.take(out, rows * K + k_live, axis=0)
+            live_score = jnp.take_along_axis(live, k_live[:, None], 1)[:, 0]
+            live_len = jnp.take_along_axis(emitted, k_live[:, None], 1)[:, 0]
+            use_fin = best_score >= live_score
+            final_out = jnp.where(use_fin[:, None], best_out, live_out)
+            final_len = jnp.where(use_fin, best_len, live_len)
+            return final_out, jnp.max(final_len), cache
+
+        loop = jax.jit(_loop, donate_argnums=(2, 3))
+        _loop_cache_put(key, loop)
+
+    out, n_emitted, _ = loop(params, logits, cache, out0)
     return out[:, : prompt_len + int(jax.device_get(n_emitted))]

@@ -211,14 +211,41 @@ class InferenceEngine:
         temperature: float = 0.0,
         top_k: int = 0,
         top_p: float = 1.0,
+        num_beams: int = 1,
+        length_penalty: float = 1.0,
     ):
         """Token generation (greedy by default; temperature/top-k/top-p
-        sampling like the reference's HF-generate dispatch,
+        sampling and beam search like the reference's HF-generate dispatch,
         ``deepspeed/inference/engine.py:578``). Kernel-injected models take
-        the KV-cached single-program decode loop; arbitrary modules get one
-        full-forward compiled program per (batch, max_len) bucket."""
+        the KV-cached single-program decode loop (beam search reorders the
+        cache on device); arbitrary modules get one full-forward compiled
+        program per (batch, max_len) bucket."""
         from deepspeed_tpu.inference.generation import greedy_generate
 
+        if num_beams > 1:
+            if self._ds_config is None or self._params is None:
+                raise NotImplementedError(
+                    "num_beams > 1 requires the kernel-injected (KV-cached) "
+                    "path: build the engine with replace_with_kernel_inject "
+                    "or a converted model family"
+                )
+            if temperature or top_k or top_p < 1.0:
+                raise ValueError(
+                    "beam search is deterministic; temperature/top_k/top_p "
+                    "cannot be combined with num_beams > 1"
+                )
+            from deepspeed_tpu.inference.decode import beam_generate
+
+            return beam_generate(
+                self._ds_config,
+                self._params,
+                input_ids,
+                max_new_tokens,
+                num_beams=num_beams,
+                eos_token_id=eos_token_id,
+                pad_token_id=pad_token_id,
+                length_penalty=length_penalty,
+            )
         if self._zero_config is not None:
             if self._param_stream is None:
                 self.init_params(jnp.asarray(input_ids))
