@@ -348,19 +348,22 @@ def beam_generate(
     (``deepspeed/inference/engine.py:578``), which re-orders its past-KV
     tuples on the host every step. Here beams are a device-side batch
     dimension: the prompt prefills ONCE at batch B, the cache is tiled to
-    B*K rows on the host side of the loop (so the loop can donate and alias
-    it), and each step's beam reorder is a gather over the cache's batch
-    axis INSIDE the compiled ``lax.while_loop`` — no host round-trips until
-    the final fetch.
+    B*K rows before the loop (so the loop donates and aliases it in place),
+    and each step's beam reorder is a gather over the cache's batch axis
+    INSIDE the compiled ``lax.while_loop`` — no host round-trips until the
+    final fetch.
 
-    Hypothesis bookkeeping follows HF's BeamSearchScorer semantics: a beam
-    that emits EOS is recorded into a per-row best-finished register (score
-    = cum_logprob / emitted**length_penalty) and leaves the active set (its
-    cum drops to -inf), freeing its slot for live continuations; the final
-    answer is the better of the best finished hypothesis and the best live
-    beam. First-expansion dedup uses the standard trick: beam 0 starts at
-    cum 0 and the rest at -inf, so the first top-K draws K distinct tokens.
-    Returns [B, prompt_len + emitted].
+    Hypothesis semantics follow HF's BeamSearchScorer with
+    ``early_stopping=True``: each step draws 2K candidates so EOS landings
+    never shrink the live set below K; EOS candidates are recorded into a
+    per-row best-finished register scored by
+    ``cum_logprob / (prompt_len + emitted)**length_penalty`` (full sequence
+    length, the HF denominator) and the K best non-EOS candidates continue;
+    a row stops once K finished hypotheses have been seen. The final answer
+    is the better of the best finished hypothesis and the best live beam.
+    First-expansion dedup: beam 0 starts at cum 0, the rest at -inf, so the
+    first top-2K draw expands distinct tokens. Returns
+    [B, prompt_len + emitted].
     """
     K = int(num_beams)
     tokens = jnp.asarray(input_ids)
@@ -390,81 +393,97 @@ def beam_generate(
     if loop is None:
 
         def _norm_score(cum, emitted):
-            denom = jnp.maximum(emitted, 1).astype(jnp.float32) ** length_penalty
-            return cum / denom
+            # HF denominator: the FULL sequence length (prompt + generated)
+            length = (prompt_len + jnp.maximum(emitted, 1)).astype(jnp.float32)
+            return cum / length**length_penalty
 
         def _loop(params, logits, cache, out):
             cum0 = jnp.full((B, K), NEG_INF_F, jnp.float32).at[:, 0].set(0.0)
+            rows = jnp.arange(B, dtype=jnp.int32)
 
             def cond(c):
-                step, finished = c[0], c[5]
-                return jnp.logical_and(
-                    step < max_new_tokens, jnp.logical_not(jnp.all(finished))
+                step, done_count = c[0], c[5]
+                live = (
+                    jnp.any(done_count < K)
+                    if eos_token_id is not None
+                    else jnp.bool_(True)
                 )
+                return jnp.logical_and(step < max_new_tokens, live)
 
             def body(c):
-                (step, logits, cache, out, cum, finished, emitted,
+                (step, logits, cache, out, cum, done_count, emitted,
                  best_score, best_out, best_len) = c
                 logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-                total = cum[:, :, None] + logp.reshape(B, K, V)  # [B, K, V]
-                new_cum, flat_idx = jax.lax.top_k(total.reshape(B, K * V), K)
-                beam_src = flat_idx // V  # [B, K] index into old beams
-                tok = (flat_idx % V).astype(out.dtype)  # [B, K]
+                total = cum[:, :, None] + logp.reshape(B, K, V)
+                # 2K candidates (HF): EOS landings never starve the live set
+                cand_cum, flat_idx = jax.lax.top_k(total.reshape(B, K * V), 2 * K)
+                cand_beam = flat_idx // V  # [B, 2K]
+                cand_tok = flat_idx % V
 
-                # reorder every per-beam carry by beam_src
-                flat_src = (
-                    beam_src + jnp.arange(B, dtype=beam_src.dtype)[:, None] * K
-                ).reshape(B * K)
+                if eos_token_id is not None:
+                    is_eos = cand_tok == eos_token_id
+                    cand_emit = (
+                        jnp.take_along_axis(emitted, cand_beam, axis=1) + 1
+                    )
+                    fin = jnp.where(
+                        is_eos, _norm_score(cand_cum, cand_emit), NEG_INF_F
+                    )
+                    j = jnp.argmax(fin, axis=1)
+                    row_score = jnp.take_along_axis(fin, j[:, None], 1)[:, 0]
+                    src = rows * K + jnp.take_along_axis(cand_beam, j[:, None], 1)[:, 0]
+                    cand_out = jnp.take(out, src, axis=0)
+                    cand_out = jax.lax.dynamic_update_slice(
+                        cand_out,
+                        jnp.full((B, 1), eos_token_id, out.dtype),
+                        (0, prompt_len + step),
+                    )
+                    better = row_score > best_score
+                    best_out = jnp.where(better[:, None], cand_out, best_out)
+                    best_score = jnp.where(better, row_score, best_score)
+                    best_len = jnp.where(
+                        better,
+                        jnp.take_along_axis(cand_emit, j[:, None], 1)[:, 0],
+                        best_len,
+                    )
+                    done_count = done_count + jnp.sum(is_eos, axis=1)
+                    live_vals = jnp.where(is_eos, NEG_INF_F, cand_cum)
+                else:
+                    live_vals = cand_cum
+
+                new_cum, pick = jax.lax.top_k(live_vals, K)  # [B, K] into 2K
+                beam_src = jnp.take_along_axis(cand_beam, pick, axis=1)
+                tok = jnp.take_along_axis(cand_tok, pick, axis=1).astype(out.dtype)
+
+                flat_src = (beam_src + rows[:, None] * K).reshape(B * K)
                 out = jnp.take(out, flat_src, axis=0)
                 cache = KVCache(
                     k=jnp.take(cache.k, flat_src, axis=1),
                     v=jnp.take(cache.v, flat_src, axis=1),
                 )
-                emitted = jnp.take(emitted.reshape(B * K), flat_src).reshape(B, K)
+                emitted = jnp.take(emitted.reshape(B * K), flat_src).reshape(B, K) + 1
 
                 flat_tok = tok.reshape(B * K)
                 out = jax.lax.dynamic_update_slice(
                     out, flat_tok[:, None], (0, prompt_len + step)
                 )
-                emitted = emitted + 1
-                if eos_token_id is not None:
-                    just_done = tok == eos_token_id  # [B, K]
-                    # record the best just-finished hypothesis per row, then
-                    # retire those beams (cum -> -inf frees their slots)
-                    cand = jnp.where(
-                        just_done, _norm_score(new_cum, emitted), NEG_INF_F
-                    )
-                    k_best = jnp.argmax(cand, axis=1)  # [B]
-                    row_score = jnp.take_along_axis(cand, k_best[:, None], 1)[:, 0]
-                    rows = jnp.arange(B, dtype=k_best.dtype)
-                    cand_out = jnp.take(out, rows * K + k_best, axis=0)
-                    cand_len = jnp.take_along_axis(emitted, k_best[:, None], 1)[:, 0]
-                    better = row_score > best_score
-                    best_out = jnp.where(better[:, None], cand_out, best_out)
-                    best_score = jnp.where(better, row_score, best_score)
-                    best_len = jnp.where(better, cand_len, best_len)
-                    new_cum = jnp.where(just_done, NEG_INF_F, new_cum)
-                    finished = new_cum <= NEG_INF_F / 2  # all slots dead?
                 logits, cache = _forward_with_cache(
                     cfg, params, flat_tok[:, None], cache, prompt_len + step
                 )
-                return (step + 1, logits, cache, out, new_cum, finished,
+                return (step + 1, logits, cache, out, new_cum, done_count,
                         emitted, best_score, best_out, best_len)
 
             state = (
                 jnp.int32(0), logits, cache, out, cum0,
-                jnp.zeros((B, K), bool),                 # finished (slot dead)
+                jnp.zeros((B,), jnp.int32),              # finished hyps seen
                 jnp.zeros((B, K), jnp.int32),            # emitted per live beam
                 jnp.full((B,), NEG_INF_F, jnp.float32),  # best finished score
-                out[::K].copy() if K > 1 else out.copy(),  # best finished seq
+                out[::K],                                # best finished seq
                 jnp.zeros((B,), jnp.int32),              # its emitted length
             )
             (step, _, cache, out, cum, _, emitted,
              best_score, best_out, best_len) = jax.lax.while_loop(cond, body, state)
-            # better of: best finished hypothesis vs best live beam
-            live = _norm_score(cum, emitted)  # retired slots are -inf
+            live = _norm_score(cum, emitted)
             k_live = jnp.argmax(live, axis=1)
-            rows = jnp.arange(B, dtype=k_live.dtype)
             live_out = jnp.take(out, rows * K + k_live, axis=0)
             live_score = jnp.take_along_axis(live, k_live[:, None], 1)[:, 0]
             live_len = jnp.take_along_axis(emitted, k_live[:, None], 1)[:, 0]

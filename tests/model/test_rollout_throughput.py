@@ -1,0 +1,57 @@
+"""Hybrid-engine rollout throughput: cached decode vs full-forward (nightly).
+
+The reference's hybrid engine exists to make RLHF rollouts fast via
+kernel-injected cached inference (``deepspeed/runtime/hybrid_engine.py:32``);
+round 3's rollout here re-ran a full-sequence forward per emitted token.
+This test pins the fix: at a few-hundred-token context the KV-cached decode
+loop must beat the full-forward-per-token loop by a wide margin (the gap
+only widens with context — at the DS-Chat 2k context the per-token cost
+ratio is ~context/1).
+"""
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu.parallel.mesh as mesh_mod
+from deepspeed_tpu.inference.decode import generate as kv_generate
+from deepspeed_tpu.inference.generation import greedy_generate
+from deepspeed_tpu.models import TransformerLM, llama_config
+
+pytestmark = pytest.mark.nightly
+
+CTX, NEW = 256, 12
+
+
+def test_cached_rollout_beats_full_forward():
+    mesh_mod.reset_topology()
+    cfg = llama_config("tiny", num_layers=2, max_seq_len=CTX + NEW, vocab_size=512)
+    model = TransformerLM(cfg)
+    rs = np.random.RandomState(0)
+    prompt = rs.randint(0, 512, (2, CTX)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)
+
+    def apply_fn(p, toks, rng):  # noqa: ARG001
+        return model.apply(p, toks, train=False)
+
+    def run(fn):
+        out = fn()  # compile
+        jax.device_get(np.asarray(out[0, -1]))
+        t0 = time.perf_counter()
+        out = fn()
+        jax.device_get(np.asarray(out[0, -1]))
+        return time.perf_counter() - t0, np.asarray(out)
+
+    rng = jax.random.PRNGKey(1)
+    full_cache = {}  # shared across warmup + timed run: the timed call
+    # must hit the compiled step, not re-trace it
+    t_full, out_full = run(
+        lambda: greedy_generate(apply_fn, params, prompt, NEW, rng, jit_cache=full_cache)
+    )
+    t_kv, out_kv = run(lambda: kv_generate(cfg, params, prompt, NEW))
+
+    # identical greedy tokens, much faster
+    np.testing.assert_array_equal(out_kv[:, : out_full.shape[1]], out_full)
+    assert t_full / t_kv >= 3.0, f"cached rollout only {t_full / t_kv:.1f}x faster"

@@ -65,7 +65,7 @@ def test_beam4_scores_at_least_greedy(model_and_params):
 def test_beam_eos_stops(model_and_params):
     cfg, model, params, prompt = model_and_params
     # pick the greedy first token of row 0 as "EOS": beams finish fast and
-    # the loop must exit early with a short, padded output
+    # the loop must EXIT EARLY (strictly fewer than NEW emitted tokens)
     greedy = np.asarray(generate(cfg, params, prompt, NEW))
     eos = int(greedy[0, prompt.shape[1]])
     out = np.asarray(
@@ -74,7 +74,10 @@ def test_beam_eos_stops(model_and_params):
         )
     )
     assert out.shape[0] == 2
-    assert out.shape[1] <= prompt.shape[1] + NEW
+    assert out.shape[1] < prompt.shape[1] + NEW, "no early exit on EOS"
+    # row 0's returned hypothesis actually ends in EOS
+    gen0 = out[0, prompt.shape[1]:]
+    assert eos in gen0.tolist()
 
 
 def test_engine_generate_num_beams(model_and_params):
