@@ -9,8 +9,10 @@ from deepspeed_tpu.checkpoint.deepspeed_checkpoint import (
 from deepspeed_tpu.checkpoint.reference_export import export_reference_checkpoint
 from deepspeed_tpu.checkpoint.reference_ingest import (
     ingest_reference_checkpoint,
+    ingest_universal_checkpoint,
     merge_reference_model_states,
     merge_reference_zero_fp32,
+    read_universal_dir,
 )
 from deepspeed_tpu.checkpoint.reshape_utils import (
     ReshapeMeg2D,

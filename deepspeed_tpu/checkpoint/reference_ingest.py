@@ -237,3 +237,94 @@ def ingest_reference_checkpoint(
         ranks=[0],
     )
     return ds_model, params, meta
+
+
+def read_universal_dir(universal_dir: str) -> Dict[str, Dict[str, np.ndarray]]:
+    """Read a reference *universal* checkpoint directory (the layout
+    ``ds_to_universal.py`` writes and ``universal_checkpoint.py:12``
+    ``load_hp_checkpoint_state`` reads): one folder per parameter holding
+    ``fp32.pt`` plus optimizer-state files (``exp_avg.pt``,
+    ``exp_avg_sq.pt``), each a torch file with the full (TP-merged,
+    padding-stripped) tensor under the ``param`` key (raw-tensor files are
+    tolerated). Returns ``{key: {param_name: ndarray}}`` for every key
+    found, e.g. ``{"fp32": {...}, "exp_avg": {...}}``."""
+    root = universal_dir
+    zero = os.path.join(root, "zero")
+    if os.path.isdir(zero):
+        root = zero
+    if not os.path.isdir(root):
+        raise FileNotFoundError(
+            f"{universal_dir} is not a universal checkpoint directory "
+            "(expected <dir>/zero/<param>/fp32.pt folders)"
+        )
+    out: Dict[str, Dict[str, np.ndarray]] = {}
+    found_any = False
+    for name in sorted(os.listdir(root)):
+        folder = os.path.join(root, name)
+        if not os.path.isdir(folder):
+            continue
+        for fn in sorted(os.listdir(folder)):
+            if not fn.endswith(".pt"):
+                continue
+            key = fn[:-3]
+            blob = _torch_load(os.path.join(folder, fn))
+            tensor = blob.get("param") if isinstance(blob, dict) else blob
+            if tensor is None:
+                continue
+            out.setdefault(key, {})[name] = _to_numpy(tensor)
+            found_any = True
+    if not found_any:
+        raise FileNotFoundError(
+            f"no <param>/<key>.pt files under {universal_dir} — not a "
+            "universal checkpoint"
+        )
+    return out
+
+
+def ingest_universal_checkpoint(
+    universal_dir: str,
+    model_config: Any,
+    model_type: Optional[str] = None,
+    load_optimizer: bool = True,
+    dtype: Optional[str] = None,
+):
+    """Reference universal checkpoint (``ds_to_universal`` output) → fused
+    TPU model + params (+ Adam moments), loadable into ANY mesh.
+
+    The universal format already carries full, TP-merged, padding-free fp32
+    tensors per parameter — so unlike ``ingest_reference_checkpoint`` there
+    is no shard merging; the per-architecture policy walk
+    (``module_inject/containers.py``) maps torch names into the fused
+    layout, and because the optimizer moments are shaped exactly like their
+    parameters, the SAME walk converts ``exp_avg``/``exp_avg_sq`` into a
+    moments tree aligned with the param tree.
+
+    Returns ``(ds_model, params, moments)`` where ``moments`` is
+    ``{"exp_avg": tree, "exp_avg_sq": tree}`` (or None)."""
+    from deepspeed_tpu.module_inject.replace_module import replace_transformer_layer
+
+    mtype = model_type or getattr(model_config, "model_type", None)
+    if mtype is None:
+        raise ValueError("model_type is required (none found on model_config)")
+    state = read_universal_dir(universal_dir)
+    if "fp32" not in state:
+        raise ValueError(
+            f"universal checkpoint at {universal_dir} has no fp32 weights"
+        )
+    ds_model, _ = replace_transformer_layer(model_config=model_config, dtype=dtype)
+    policy = policy_for(mtype)
+    params = policy.convert_weights(dict(state["fp32"]), ds_model.config)
+    moments = None
+    if load_optimizer and "exp_avg" in state and "exp_avg_sq" in state:
+        moments = {
+            "exp_avg": policy.convert_weights(dict(state["exp_avg"]), ds_model.config),
+            "exp_avg_sq": policy.convert_weights(
+                dict(state["exp_avg_sq"]), ds_model.config
+            ),
+        }
+    log_dist(
+        f"ingested universal checkpoint: {len(state['fp32'])} tensors, "
+        f"moments={'yes' if moments else 'no'}",
+        ranks=[0],
+    )
+    return ds_model, params, moments

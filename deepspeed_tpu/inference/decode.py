@@ -411,8 +411,10 @@ def beam_generate(
                 return jnp.logical_and(step < max_new_tokens, live)
 
             def body(c):
-                (step, logits, cache, out, cum, done_count, emitted,
+                (step, logits, cache, out, cum, done_count,
                  best_score, best_out, best_len) = c
+                # every live beam has emitted exactly `step` tokens (beams
+                # only permute among equals), so length is scalar state
                 logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
                 total = cum[:, :, None] + logp.reshape(B, K, V)
                 # 2K candidates (HF): EOS landings never starve the live set
@@ -422,11 +424,13 @@ def beam_generate(
 
                 if eos_token_id is not None:
                     is_eos = cand_tok == eos_token_id
-                    cand_emit = (
-                        jnp.take_along_axis(emitted, cand_beam, axis=1) + 1
-                    )
+                    # HF records/counts ONLY EOS candidates ranked < K
+                    # (BeamSearchScorer: beam_token_rank >= group_size -> skip);
+                    # lower-ranked EOS are neither recorded nor continued
+                    topk_rank = jnp.arange(2 * K) < K
+                    rec = is_eos & topk_rank[None, :]
                     fin = jnp.where(
-                        is_eos, _norm_score(cand_cum, cand_emit), NEG_INF_F
+                        rec, _norm_score(cand_cum, jnp.int32(step) + 1), NEG_INF_F
                     )
                     j = jnp.argmax(fin, axis=1)
                     row_score = jnp.take_along_axis(fin, j[:, None], 1)[:, 0]
@@ -440,12 +444,8 @@ def beam_generate(
                     better = row_score > best_score
                     best_out = jnp.where(better[:, None], cand_out, best_out)
                     best_score = jnp.where(better, row_score, best_score)
-                    best_len = jnp.where(
-                        better,
-                        jnp.take_along_axis(cand_emit, j[:, None], 1)[:, 0],
-                        best_len,
-                    )
-                    done_count = done_count + jnp.sum(is_eos, axis=1)
+                    best_len = jnp.where(better, step + 1, best_len)
+                    done_count = done_count + jnp.sum(rec, axis=1)
                     live_vals = jnp.where(is_eos, NEG_INF_F, cand_cum)
                 else:
                     live_vals = cand_cum
@@ -460,7 +460,6 @@ def beam_generate(
                     k=jnp.take(cache.k, flat_src, axis=1),
                     v=jnp.take(cache.v, flat_src, axis=1),
                 )
-                emitted = jnp.take(emitted.reshape(B * K), flat_src).reshape(B, K) + 1
 
                 flat_tok = tok.reshape(B * K)
                 out = jax.lax.dynamic_update_slice(
@@ -470,26 +469,24 @@ def beam_generate(
                     cfg, params, flat_tok[:, None], cache, prompt_len + step
                 )
                 return (step + 1, logits, cache, out, new_cum, done_count,
-                        emitted, best_score, best_out, best_len)
+                        best_score, best_out, best_len)
 
             state = (
                 jnp.int32(0), logits, cache, out, cum0,
                 jnp.zeros((B,), jnp.int32),              # finished hyps seen
-                jnp.zeros((B, K), jnp.int32),            # emitted per live beam
                 jnp.full((B,), NEG_INF_F, jnp.float32),  # best finished score
                 out[::K],                                # best finished seq
                 jnp.zeros((B,), jnp.int32),              # its emitted length
             )
-            (step, _, cache, out, cum, _, emitted,
+            (step, _, cache, out, cum, _,
              best_score, best_out, best_len) = jax.lax.while_loop(cond, body, state)
-            live = _norm_score(cum, emitted)
+            live = _norm_score(cum, step)  # every live beam emitted `step`
             k_live = jnp.argmax(live, axis=1)
             live_out = jnp.take(out, rows * K + k_live, axis=0)
             live_score = jnp.take_along_axis(live, k_live[:, None], 1)[:, 0]
-            live_len = jnp.take_along_axis(emitted, k_live[:, None], 1)[:, 0]
             use_fin = best_score >= live_score
             final_out = jnp.where(use_fin[:, None], best_out, live_out)
-            final_len = jnp.where(use_fin, best_len, live_len)
+            final_len = jnp.where(use_fin, best_len, step)
             return final_out, jnp.max(final_len), cache
 
         loop = jax.jit(_loop, donate_argnums=(2, 3))
