@@ -326,3 +326,312 @@ def mpi_discovery(distributed_port: int = 29500, verbose: bool = True) -> None:
         logger.info(
             f"MPI discovery: rank={os.environ['RANK']} world={os.environ['WORLD_SIZE']}"
         )
+
+
+# -- remaining torch.distributed-shaped surface (reference comm.py) --------
+# Control-plane implementations: correct semantics composed from the
+# rendezvous primitives above. The HOT paths never come through here — in
+# compiled programs the GSPMD partitioner / lax collectives own the wire.
+
+
+def is_available() -> bool:
+    """Parity with torch.distributed.is_available (comm facade probe)."""
+    return True
+
+
+_world_group_cache = {}
+
+
+def get_world_group():
+    """The default (world) group handle (reference get_world_group) —
+    cached so identity checks and hot loops don't re-allocate."""
+    n = get_world_size()
+    if n not in _world_group_cache:
+        _world_group_cache[n] = new_group(list(range(n)))
+    return _world_group_cache[n]
+
+
+@timed_op
+def reduce(tensor, dst: int = 0, op: ReduceOp = ReduceOp.SUM, group=None, async_op: bool = False):  # noqa: ARG001
+    """Reference ``reduce``: result is defined on ``dst``. The SPMD control
+    plane computes it everywhere (an all-reduce); returns the reduced value
+    on every rank — a superset of the contract."""
+    return all_reduce(tensor, op=op, group=group)
+
+
+@timed_op
+def gather(tensor, gather_list: Optional[list] = None, dst: int = 0, group=None, async_op: bool = False):  # noqa: ARG001
+    """Reference ``gather``: ``gather_list`` is filled on ``dst`` (here: on
+    every rank — the all-gather superset)."""
+    gathered = all_gather(None, tensor, group=group)
+    if gather_list is not None and get_rank() == dst:
+        gather_list.clear()
+        gather_list.extend(list(gathered))
+    return gathered
+
+
+@timed_op
+def all_gather_into_tensor(output_tensor, input_tensor, group=None, async_op: bool = False):  # noqa: ARG001
+    """Flat-output all-gather (reference comm.py all_gather_into_tensor /
+    torch dist.all_gather_into_tensor). Returns the stacked array (JAX
+    arrays are immutable; callers assign)."""
+    gathered = all_gather(None, input_tensor, group=group)
+    return np.concatenate([np.asarray(g).reshape(-1) for g in gathered]).reshape(
+        np.shape(output_tensor)
+    )
+
+
+def allgather_fn(output_tensor, input_tensor, group=None, debug=False):  # noqa: ARG001
+    return all_gather_into_tensor(output_tensor, input_tensor, group=group)
+
+
+@timed_op
+def reduce_scatter(output, input_list, op: ReduceOp = ReduceOp.SUM, group=None, async_op: bool = False):  # noqa: ARG001
+    """Reduce a per-rank list and keep this rank's entry."""
+    stacked = np.stack([np.asarray(t) for t in input_list])
+    reduced = all_reduce(stacked, op=op, group=group)
+    return reduced[get_rank()]
+
+
+@timed_op
+def reduce_scatter_tensor(output_tensor, tensor, op: ReduceOp = ReduceOp.SUM, group=None, async_op: bool = False):  # noqa: ARG001
+    """Flat-tensor reduce-scatter (this rank's contiguous chunk)."""
+    reduced = all_reduce(np.asarray(tensor).reshape(-1), op=op, group=group)
+    chunk = reduced.reshape(get_world_size(), -1)[get_rank()]
+    return chunk.reshape(np.shape(output_tensor))
+
+
+def reduce_scatter_fn(output_tensor, tensor, op: ReduceOp = ReduceOp.SUM, group=None, async_op: bool = False, debug=False):  # noqa: ARG001
+    return reduce_scatter_tensor(output_tensor, tensor, op=op, group=group)
+
+
+@timed_op
+def all_to_all_single(output, tensor, output_split_sizes=None, input_split_sizes=None, group=None, async_op: bool = False):  # noqa: ARG001
+    """Each rank sends chunk i of its input to rank i (reference
+    comm.py:331). Control-plane: composed as gather + select; the training
+    paths' all-to-alls (MoE dispatch, Ulysses) are ``lax.all_to_all`` inside
+    the compiled programs (``comm/collectives.py``), not this."""
+    world, rank = get_world_size(), get_rank()
+    arr = np.asarray(tensor)
+    if input_split_sizes is None:
+        chunks = np.split(arr, world, axis=0)
+    else:
+        idx = np.cumsum(input_split_sizes)[:-1]
+        chunks = np.split(arr, idx, axis=0)
+    # one rendezvous: gather every rank's chunk list, then keep the chunk
+    # each source addressed to this rank
+    full = all_gather_object([np.asarray(c) for c in chunks])
+    received = [full[src][rank] for src in range(world)]
+    return np.concatenate(received, axis=0)
+
+
+@timed_op
+def all_to_all(output_tensor_list, input_tensor_list, group=None, async_op: bool = False):  # noqa: ARG001
+    """List form of all_to_all_single."""
+    world, rank = get_world_size(), get_rank()
+    full = all_gather_object([np.asarray(t) for t in input_tensor_list])
+    received = [full[src][rank] for src in range(world)]
+    if output_tensor_list is not None:
+        output_tensor_list[:] = received
+    return received
+
+
+def all_reduce_coalesced(tensors, op: ReduceOp = ReduceOp.SUM, group=None, async_op: bool = False):  # noqa: ARG001
+    """One rendezvous for a list of tensors (reference has_all_reduce_coalesced
+    capability). Each tensor keeps its own dtype — a flat concat would upcast
+    mixed lists (int flags next to f32 grads) to a common type."""
+    arrs = [np.asarray(t) for t in tensors]
+    if get_world_size() == 1 or not arrs:
+        return arrs
+    per_rank = all_gather_object(arrs)  # the single rendezvous
+    out = []
+    for i, a in enumerate(arrs):
+        stack = np.stack([np.asarray(r[i]) for r in per_rank])
+        if op in (ReduceOp.SUM, ReduceOp.AVG):
+            red = stack.sum(axis=0)
+            if op == ReduceOp.AVG:
+                red = red / get_world_size()
+        elif op == ReduceOp.MAX:
+            red = stack.max(axis=0)
+        elif op == ReduceOp.MIN:
+            red = stack.min(axis=0)
+        elif op == ReduceOp.PRODUCT:
+            red = stack.prod(axis=0)
+        else:
+            raise DSCommError(f"unsupported eager reduce op {op}")
+        out.append(red.astype(a.dtype, copy=False))
+    return out
+
+
+def all_gather_coalesced(tensor_list, group=None, async_op: bool = False):  # noqa: ARG001
+    """Coalesced all-gather: one rendezvous, per-rank lists back."""
+    world = get_world_size()
+    full = all_gather_object([np.asarray(t) for t in tensor_list])
+    return [[full[r][i] for r in range(world)] for i in range(len(tensor_list))]
+
+
+def inference_all_reduce(tensor, op: ReduceOp = ReduceOp.SUM, group=None, async_op: bool = False):  # noqa: ARG001
+    """Reference TorchBackend.inference_all_reduce: same reduction, fast
+    path hint only — on TPU the inference TP reduction is a GSPMD psum
+    inside the jitted forward, so the control-plane form just reduces."""
+    return all_reduce(tensor, op=op, group=group)
+
+
+def monitored_barrier(group=None, timeout=None, wait_all_ranks: bool = False, name: str = "") -> None:  # noqa: ARG001
+    """Barrier with slow-rank visibility (torch monitored_barrier). A
+    watchdog thread logs WHILE the barrier is stuck (a post-hoc check could
+    never fire on a genuine missing-rank hang); the barrier itself cannot be
+    aborted, so like the rendezvous it rides, this surfaces the hang rather
+    than raising past it."""
+    import threading
+
+    limit = timeout if timeout is not None else 300.0
+    try:
+        limit = float(getattr(limit, "total_seconds", lambda: limit)())
+    except Exception:
+        limit = 300.0
+    done = threading.Event()
+
+    def _watch():
+        waited = 0.0
+        while not done.wait(min(limit, 30.0)):
+            waited += min(limit, 30.0)
+            if waited >= limit:
+                logger.warning(
+                    f"monitored_barrier '{name}' still waiting after "
+                    f"{waited:.0f}s (limit {limit:.0f}s) — a rank may be down"
+                )
+
+    if get_world_size() > 1:
+        t = threading.Thread(target=_watch, daemon=True)
+        t.start()
+    t0 = time.time()
+    try:
+        barrier(group=group, name=name or "ds_monitored_barrier")
+    finally:
+        done.set()
+    dt = time.time() - t0
+    if dt > limit:
+        logger.warning(f"monitored_barrier took {dt:.1f}s (limit {limit:.1f}s)")
+
+
+# point-to-point (reference comm.py isend/irecv/send/recv). The training
+# pipeline never uses host p2p — stage handoffs are ppermute INSIDE the
+# compiled program (runtime/pipe/spmd.py) — so these exist for the control
+# plane and API parity. The rendezvous primitives are collective, so p2p is
+# cooperative: every p2p call is one exchange ROUND in which all ranks
+# publish their pending outbound messages into per-(src,dst,tag) mailboxes;
+# receives drain the mailbox first and only join further rounds while
+# empty-handed. This makes the standard nonblocking orderings (both ranks
+# isend then irecv) deliver correctly instead of pairing sends with sends.
+_p2p_mailbox: dict = {}
+_P2P_MAX_ROUNDS = 1000
+
+
+def _p2p_round(outbound: list) -> None:
+    for msgs in all_gather_object(outbound):
+        for (s, d, t, payload) in msgs or []:
+            _p2p_mailbox.setdefault((s, d, t), []).append(payload)
+
+
+def send(tensor, dst: int, group=None, tag: int = 0) -> None:  # noqa: ARG001
+    if get_world_size() == 1:
+        _p2p_mailbox.setdefault((0, 0, tag), []).append(np.asarray(tensor))
+        return
+    _p2p_round([(get_rank(), dst, tag, np.asarray(tensor))])
+
+
+def recv(tensor, src: int, group=None, tag: int = 0):  # noqa: ARG001
+    key = (src, get_rank(), tag)
+    if get_world_size() == 1:
+        box = _p2p_mailbox.get(key)
+        return box.pop(0) if box else None
+    for _ in range(_P2P_MAX_ROUNDS):
+        box = _p2p_mailbox.get(key)
+        if box:
+            return box.pop(0)
+        _p2p_round([])
+    raise DSCommError(
+        f"recv(src={src}, tag={tag}) saw no matching send after "
+        f"{_P2P_MAX_ROUNDS} exchange rounds"
+    )
+
+
+class _Work:
+    """Completed-work handle (torch dist.Work parity for isend/irecv)."""
+
+    def __init__(self, value=None):
+        self.value = value
+
+    def wait(self):
+        return self.value
+
+    def is_completed(self) -> bool:
+        return True
+
+
+def isend(tensor, dst: int, group=None, tag: int = 0) -> _Work:  # noqa: ARG001
+    send(tensor, dst, group=group, tag=tag)
+    return _Work()
+
+
+def irecv(tensor, src: int, group=None, tag: int = 0) -> _Work:  # noqa: ARG001
+    return _Work(recv(tensor, src, group=group, tag=tag))
+
+
+# cloud environment detection + env patches (reference comm.py:726,758) ----
+def in_aml() -> bool:
+    return "AZUREML_EXPERIMENT_ID" in os.environ
+
+
+def in_aws_sm() -> bool:
+    return "SM_TRAINING_ENV" in os.environ
+
+
+def in_dlts() -> bool:
+    return "DLTS_JOB_ID" in os.environ
+
+
+def patch_aml_env_for_torch_nccl_backend(master_port: int = 6105, verbose: bool = True) -> None:
+    """AzureML: derive RANK/WORLD_SIZE/MASTER_* from the MPI envs AML sets
+    (reference comm.py:726)."""
+    # OVERWRITE (not setdefault): a stale RANK=0 exported on every node must
+    # lose to the MPI-provided values or every process claims rank 0
+    os.environ["RANK"] = os.environ.get("OMPI_COMM_WORLD_RANK", os.environ.get("RANK", "0"))
+    os.environ["WORLD_SIZE"] = os.environ.get(
+        "OMPI_COMM_WORLD_SIZE", os.environ.get("WORLD_SIZE", "1")
+    )
+    single_node = int(os.environ.get("OMPI_COMM_WORLD_LOCAL_SIZE", "1")) == int(
+        os.environ.get("WORLD_SIZE", "1")
+    )
+    if not single_node:
+        master_node_params = os.environ.get("AZ_BATCH_MASTER_NODE", ":").split(":")
+        os.environ.setdefault("MASTER_ADDR", master_node_params[0])
+        if len(master_node_params) > 1 and master_node_params[1]:
+            os.environ.setdefault("MASTER_PORT", master_node_params[1])
+    else:
+        os.environ.setdefault("MASTER_ADDR", os.environ.get("AZ_BATCHAI_MPI_MASTER_NODE", "127.0.0.1"))
+        os.environ.setdefault("MASTER_PORT", str(master_port))
+    os.environ["LOCAL_RANK"] = os.environ.get(
+        "OMPI_COMM_WORLD_LOCAL_RANK", os.environ.get("LOCAL_RANK", "0")
+    )
+    if verbose:
+        logger.info(
+            f"AML env: rank={os.environ['RANK']} world={os.environ['WORLD_SIZE']} "
+            f"master={os.environ.get('MASTER_ADDR')}:{os.environ.get('MASTER_PORT')}"
+        )
+
+
+def patch_aws_sm_env_for_torch_nccl_backend(verbose: bool = True) -> None:
+    """SageMaker: RANK/LOCAL_RANK from the SM MPI envs (reference comm.py:758)."""
+    os.environ["RANK"] = os.environ.get("OMPI_COMM_WORLD_RANK", os.environ.get("RANK", "0"))
+    os.environ["LOCAL_RANK"] = os.environ.get(
+        "OMPI_COMM_WORLD_LOCAL_RANK", os.environ.get("LOCAL_RANK", "0")
+    )
+    os.environ["WORLD_SIZE"] = os.environ.get(
+        "OMPI_COMM_WORLD_SIZE", os.environ.get("WORLD_SIZE", "1")
+    )
+    if verbose:
+        logger.info(
+            f"SageMaker env: rank={os.environ['RANK']} world={os.environ['WORLD_SIZE']}"
+        )
