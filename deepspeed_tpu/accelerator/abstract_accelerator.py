@@ -133,6 +133,22 @@ class DeepSpeedAccelerator(abc.ABC):
     def memory_stats(self, device_index: Optional[int] = None) -> Dict[str, Any]:
         return {}
 
+    # deprecated torch aliases kept for reference API parity
+    # (abstract_accelerator.py memory_cached family + manual_seed_all)
+    def memory_cached(self, device_index: Optional[int] = None) -> int:
+        return self.memory_reserved(device_index)
+
+    def max_memory_cached(self, device_index: Optional[int] = None) -> int:
+        return self.max_memory_reserved(device_index)
+
+    def reset_max_memory_cached(self, device_index: Optional[int] = None) -> None:
+        # the 'cached' family is the reserved family: reset the peak stats
+        # (which cover reserved peaks) so the read/reset pair stays coherent
+        self.reset_peak_memory_stats(device_index)
+
+    def manual_seed_all(self, seed: int) -> None:
+        self.manual_seed(seed)
+
     def reset_peak_memory_stats(self, device_index: Optional[int] = None) -> None:
         pass
 

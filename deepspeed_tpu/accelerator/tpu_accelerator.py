@@ -138,9 +138,6 @@ class TPU_Accelerator(DeepSpeedAccelerator):
         self._seed = int(seed)
         self._rng_key = jax.random.PRNGKey(self._seed)
 
-    def manual_seed_all(self, seed: int) -> None:
-        self.manual_seed(seed)
-
     def initial_seed(self) -> int:
         return self._seed
 
