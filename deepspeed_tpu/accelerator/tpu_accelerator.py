@@ -74,6 +74,7 @@ class TPU_Accelerator(DeepSpeedAccelerator):
         self._current_device_index = 0
         self._seed = 42
         self._rng_key = None
+        self._peak_marks = {}  # device_index -> peak watermark at last reset
 
     def _jax(self):
         import jax
@@ -175,10 +176,24 @@ class TPU_Accelerator(DeepSpeedAccelerator):
         return int(self._memory_stats(device_index).get("bytes_in_use", 0))
 
     def max_memory_allocated(self, device_index: Optional[int] = None) -> int:
-        return int(self._memory_stats(device_index).get("peak_bytes_in_use", 0))
+        """Peak since the last reset. The XLA allocator's peak_bytes_in_use
+        is process-lifetime and cannot be cleared, so resets record a
+        watermark: while the all-time peak hasn't moved past it, the current
+        usage is the best available 'peak since reset'."""
+        stats = self._memory_stats(device_index)
+        peak = int(stats.get("peak_bytes_in_use", 0))
+        mark = self._peak_marks.get(device_index, 0)
+        if peak > mark:
+            return peak
+        return int(stats.get("bytes_in_use", 0))
 
     def reset_max_memory_allocated(self, device_index: Optional[int] = None) -> None:
-        pass
+        self._peak_marks[device_index] = int(
+            self._memory_stats(device_index).get("peak_bytes_in_use", 0)
+        )
+
+    def reset_peak_memory_stats(self, device_index: Optional[int] = None) -> None:
+        self.reset_max_memory_allocated(device_index)
 
     def memory_reserved(self, device_index: Optional[int] = None) -> int:
         return int(self._memory_stats(device_index).get("bytes_reserved", self.memory_allocated(device_index)))

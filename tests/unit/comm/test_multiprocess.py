@@ -47,6 +47,26 @@ total = jax.jit(lambda x: x.sum(), out_shardings=NamedSharding(mesh, P()))(arr)
 expected = 4.0 * 1 + 4.0 * 2
 got = float(jax.device_get(total.addressable_shards[0].data))
 assert got == expected, (got, expected)
+
+# facade control-plane ops across the real process boundary -----------------
+# all_to_all_single: rank r sends chunk i to rank i
+x = np.arange(4.0) + 10.0 * rank  # rank0: [0..3], rank1: [10..13]
+out = dist.all_to_all_single(None, x)
+exp = np.concatenate([np.arange(2.0) + 10.0 * s for s in range(2)]) + 2.0 * rank
+np.testing.assert_array_equal(out, exp)
+
+# dtype-preserving coalesced all-reduce (f32 + int64 flag together)
+ra, rb = dist.all_reduce_coalesced([np.arange(3, dtype=np.float32), np.array([rank], np.int64)])
+np.testing.assert_array_equal(ra, 2 * np.arange(3, dtype=np.float32))
+assert rb.dtype == np.int64 and int(rb[0]) == 1
+
+# cooperative p2p: both ranks isend then irecv (the torch nonblocking order)
+peer = 1 - rank
+dist.isend(np.full((2,), float(rank)), dst=peer)
+w = dist.irecv(None, src=peer)
+got_p2p = w.wait()
+np.testing.assert_array_equal(got_p2p, np.full((2,), float(peer)))
+
 print(f"RANK{rank} OK", flush=True)
 """
 
