@@ -180,16 +180,18 @@ class TPU_Accelerator(DeepSpeedAccelerator):
         is process-lifetime and cannot be cleared, so resets record a
         watermark: while the all-time peak hasn't moved past it, the current
         usage is the best available 'peak since reset'."""
-        stats = self._memory_stats(device_index)
+        idx = device_index if device_index is not None else self._current_device_index
+        stats = self._memory_stats(idx)
         peak = int(stats.get("peak_bytes_in_use", 0))
-        mark = self._peak_marks.get(device_index, 0)
+        mark = self._peak_marks.get(idx, 0)
         if peak > mark:
             return peak
         return int(stats.get("bytes_in_use", 0))
 
     def reset_max_memory_allocated(self, device_index: Optional[int] = None) -> None:
-        self._peak_marks[device_index] = int(
-            self._memory_stats(device_index).get("peak_bytes_in_use", 0)
+        idx = device_index if device_index is not None else self._current_device_index
+        self._peak_marks[idx] = int(
+            self._memory_stats(idx).get("peak_bytes_in_use", 0)
         )
 
     def reset_peak_memory_stats(self, device_index: Optional[int] = None) -> None:

@@ -297,7 +297,11 @@ def has_coalescing_manager() -> bool:
     return True
 
 
-def get_global_rank(group=None, group_rank: int = 0) -> int:  # noqa: ARG001
+def get_global_rank(group=None, group_rank: int = 0) -> int:
+    """Translate a rank WITHIN ``group`` to its global rank (reference
+    facade contract): group handles carry their rank list."""
+    if group is not None and hasattr(group, "ranks"):
+        return int(group.ranks[group_rank])
     return group_rank
 
 
