@@ -79,7 +79,9 @@ class TestPipelineConvergence:
 
 class TestMoEConvergence:
     def test_moe_ep2(self):
-        rec = _run_scenario("moe_ep2", 2)
+        # the MoE step (gate + capacity einsums + all_to_all) is the
+        # slowest scenario on a small host; give it more wall clock
+        rec = _run_scenario("moe_ep2", 2, timeout_s=3000)
         assert rec["final"] < 1.5, rec
         assert rec["final"] < rec["first"] / 3, rec
 

@@ -1,0 +1,39 @@
+"""16-virtual-device multichip dryrun (nightly).
+
+Axis sizes of 2 can hide divisibility/padding bugs; the driver's own dryrun
+runs at its configured device count, and this pins the larger meshes
+(dp16 ZeRO-3, dp4×tp2×sp2, pp4×dp4, ep4×dp4) as standing coverage.
+``dryrun_multichip`` re-execs itself with the right XLA flags, so this
+works from inside the 8-device suite process."""
+
+import os
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.nightly
+
+
+def test_dryrun_multichip_16():
+    """Bounded: a collective-rendezvous hang on the virtual mesh must fail
+    the test, not wedge the nightly job — so run the re-exec form in our
+    own subprocess with a timeout instead of the unbounded built-in one."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "import __graft_entry__; __graft_entry__.dryrun_multichip(16)",
+        ],
+        env=env,
+        cwd=repo,
+        capture_output=True,
+        text=True,
+        timeout=2400,
+    )
+    assert proc.returncode == 0, f"dryrun_16 failed:\n{proc.stdout[-1500:]}\n{proc.stderr[-1500:]}"
+    assert "phase 3 ok" in proc.stdout
