@@ -71,11 +71,16 @@ def _layer_project_qkv(cfg: TransformerConfig, p, h):
     )
 
 
-def _layer_mlp(cfg: TransformerConfig, p, x):
+def _ffn_body(cfg: TransformerConfig, p, x, norm_scale, norm_bias):
+    """norm → ffn, NO residual — callers place the residual per architecture."""
     from deepspeed_tpu.moe.experts import apply_dense_ffn
 
-    h = _norm(x, p["mlp_norm_scale"], p.get("mlp_norm_bias"), cfg.norm, cfg.norm_eps)
-    return x + apply_dense_ffn(p, h, cfg.activation)
+    h = _norm(x, norm_scale, norm_bias, cfg.norm, cfg.norm_eps)
+    return apply_dense_ffn(p, h, cfg.activation)
+
+
+def _layer_mlp(cfg: TransformerConfig, p, x):
+    return x + _ffn_body(cfg, p, x, p["mlp_norm_scale"], p.get("mlp_norm_bias"))
 
 
 def _cached_attention(cfg, q, k_cache, v_cache, q_positions, kv_len_mask, kv_len=None):
@@ -132,8 +137,8 @@ def _forward_with_cache(cfg, params, tokens, cache: KVCache, start_pos):
         p, k_cache_l, v_cache_l = per_layer
         q, k_new, v_new = _layer_project_qkv(cfg, p, x)
         if cfg.position == "rope":
-            q = _rope(q, positions_b, cfg.rope_theta)
-            k_new = _rope(k_new, positions_b, cfg.rope_theta)
+            q = _rope(q, positions_b, cfg.rope_theta, cfg.rope_dim)
+            k_new = _rope(k_new, positions_b, cfg.rope_theta, cfg.rope_dim)
         k_cache_l = jax.lax.dynamic_update_slice(
             k_cache_l, k_new.astype(k_cache_l.dtype), (0, start_pos, 0, 0)
         )
@@ -146,8 +151,17 @@ def _forward_with_cache(cfg, params, tokens, cache: KVCache, start_pos):
         attn = attn.reshape(B, T, cfg.num_heads * cfg.head_dim) @ p["wo"].astype(x.dtype)
         if cfg.use_bias:
             attn = attn + p["bo"].astype(x.dtype)
-        x = x + attn
-        x = _layer_mlp(cfg, p, x)
+        if cfg.parallel_residual:
+            # GPT-J/NeoX: mlp branch reads x (shared ln_1 or its own norm),
+            # not the attn-updated residual
+            norm_scale = p["attn_norm_scale"] if cfg.shared_parallel_norm else p["mlp_norm_scale"]
+            norm_bias = (
+                p.get("attn_norm_bias") if cfg.shared_parallel_norm else p.get("mlp_norm_bias")
+            )
+            x = x + attn + _ffn_body(cfg, p, x, norm_scale, norm_bias)
+        else:
+            x = x + attn
+            x = _layer_mlp(cfg, p, x)
         return x, (k_cache_l, v_cache_l)
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -161,6 +175,8 @@ def _forward_with_cache(cfg, params, tokens, cache: KVCache, start_pos):
         logits = x @ params["embed"]["tokens"].astype(x.dtype).T
     else:
         logits = x @ params["lm_head"].astype(x.dtype)
+        if cfg.lm_head_bias:
+            logits = logits + params["lm_head_bias"].astype(logits.dtype)
     return logits[:, -1, :], KVCache(k=new_k, v=new_v)
 
 

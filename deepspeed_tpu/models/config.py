@@ -26,6 +26,10 @@ class TransformerConfig:
     causal: bool = True  # False = bidirectional (encoder) attention
     attn_softmax_scale: Optional[float] = None  # None = 1/sqrt(head_dim); GPT-Neo uses 1.0
     prenorm: bool = True  # False = post-LN (BERT family): norm AFTER residual, no final norm
+    parallel_residual: bool = False  # GPT-J/NeoX: x + attn(norm(x)) + mlp(norm'(x))
+    shared_parallel_norm: bool = False  # GPT-J: both parallel branches read ONE norm (ln_1)
+    rope_dim: Optional[int] = None  # partial rotary (GPT-J rotary_dim / NeoX rotary_pct); None = full head_dim
+    lm_head_bias: bool = False  # GPT-J: untied head carries a bias
     embed_norm: bool = False  # LayerNorm on the embedding output (BERT family)
     norm: str = "layernorm"  # layernorm | rmsnorm
     norm_eps: float = 1e-5
@@ -70,6 +74,15 @@ class TransformerConfig:
                 f"unknown sequence_parallel_mode {self.sequence_parallel_mode!r}; "
                 "expected 'ulysses' or 'ring'"
             )
+        if self.shared_parallel_norm and not self.parallel_residual:
+            raise ValueError("shared_parallel_norm requires parallel_residual=True")
+        if self.parallel_residual and not self.prenorm:
+            raise ValueError(
+                "parallel_residual requires prenorm=True (both branches read "
+                "normed x; a post-LN parallel layer is not a real architecture)"
+            )
+        if self.lm_head_bias and self.tie_embeddings:
+            raise ValueError("lm_head_bias requires an untied head (tie_embeddings=False)")
         if self.sparse_embedding_grads and self.tie_embeddings:
             raise ValueError(
                 "sparse_embedding_grads requires tie_embeddings=False: a tied "

@@ -58,8 +58,13 @@ def _norm(x, scale, bias, kind: str, eps: float):
     return out.astype(x.dtype)
 
 
-def _rope(x, positions, theta: float):
-    """Rotary embedding over the last dim of [B, T, N, D]."""
+def _rope(x, positions, theta: float, rope_dim=None):
+    """Rotary embedding over the last dim of [B, T, N, D]. ``rope_dim``
+    rotates only the leading features (GPT-J rotary_dim / NeoX rotary_pct);
+    the tail passes through unrotated."""
+    if rope_dim is not None and rope_dim < x.shape[-1]:
+        rotated = _rope(x[..., :rope_dim], positions, theta)
+        return jnp.concatenate([rotated, x[..., rope_dim:]], axis=-1)
     d = x.shape[-1]
     half = d // 2
     freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
@@ -196,6 +201,8 @@ class TransformerLM(DSModule):
                 params["final_norm_bias"] = jnp.zeros((H,))
         if not cfg.tie_embeddings:
             params["lm_head"] = dense(next(k), (H, cfg.vocab_size))
+            if cfg.lm_head_bias:
+                params["lm_head_bias"] = jnp.zeros((cfg.vocab_size,))
         return params
 
     # --- TP sharding rules ----------------------------------------------
@@ -378,8 +385,8 @@ class TransformerLM(DSModule):
         k = k.reshape(B, T, NKV, D)
         v = v.reshape(B, T, NKV, D)
         if cfg.position == "rope":
-            q = _rope(q, positions, cfg.rope_theta)
-            k = _rope(k, positions, cfg.rope_theta)
+            q = _rope(q, positions, cfg.rope_theta, cfg.rope_dim)
+            k = _rope(k, positions, cfg.rope_theta, cfg.rope_dim)
         rng, r_attn, r_hid, r_mlp = jax.random.split(rng, 4) if rng is not None else (None, None, None, None)
         attn = self._attention(q, k, v, positions, r_attn, train)
         attn = attn.reshape(B, T, NH * D) @ p["wo"].astype(h.dtype)
@@ -388,6 +395,17 @@ class TransformerLM(DSModule):
         if train and cfg.hidden_dropout > 0 and r_hid is not None:
             keep = jax.random.bernoulli(r_hid, 1 - cfg.hidden_dropout, attn.shape)
             attn = attn * keep / (1 - cfg.hidden_dropout)
+        if cfg.parallel_residual:
+            # GPT-J/NeoX: both branches read x — attn already consumed
+            # norm1(x) as h; the mlp branch reads the SAME h (GPT-J shared
+            # ln_1) or its own norm2(x) (NeoX)
+            h_mlp = (
+                h
+                if cfg.shared_parallel_norm
+                else _norm(x, p["mlp_norm_scale"], p.get("mlp_norm_bias"), cfg.norm, cfg.norm_eps)
+            )
+            out, aux = self._mlp(p, h_mlp, r_mlp, train)
+            return x + attn + out, aux
         if cfg.prenorm:
             x = x + attn
             h = _norm(x, p["mlp_norm_scale"], p.get("mlp_norm_bias"), cfg.norm, cfg.norm_eps)
@@ -514,6 +532,8 @@ class TransformerLM(DSModule):
             logits = x @ params["embed"]["tokens"].astype(self.dtype).T
         else:
             logits = x @ params["lm_head"].astype(self.dtype)
+            if cfg.lm_head_bias:
+                logits = logits + params["lm_head_bias"].astype(logits.dtype)
         return logits, aux_total
 
     # --- layer streaming (ZeRO-Infinity param offload) -------------------
@@ -568,6 +588,8 @@ class TransformerLM(DSModule):
                 logits = x @ resident["embed"]["tokens"].astype(self.dtype).T
             else:
                 logits = x @ resident["lm_head"].astype(self.dtype)
+                if cfg.lm_head_bias:
+                    logits = logits + resident["lm_head_bias"].astype(logits.dtype)
             if labels is None:
                 return logits
             return cross_entropy_loss(logits, labels)

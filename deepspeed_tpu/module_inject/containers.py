@@ -219,6 +219,7 @@ class GPTNeoXPolicy(DSPolicy):
     model_types = ["gpt_neox", "gptneox"]
 
     def build_config(self, c) -> TransformerConfig:
+        head_dim = c.hidden_size // c.num_attention_heads
         return TransformerConfig(
             vocab_size=c.vocab_size,
             hidden_size=c.hidden_size,
@@ -229,9 +230,13 @@ class GPTNeoXPolicy(DSPolicy):
             norm="layernorm",
             position="rope",
             rope_theta=getattr(c, "rotary_emb_base", 10000.0),
+            # NeoX rotates rotary_pct of each head (0.25 on Pythia/NeoX-20B)
+            rope_dim=int(getattr(c, "rotary_pct", 1.0) * head_dim),
             activation="gelu",
             use_bias=True,
             tie_embeddings=False,
+            # HF default use_parallel_residual=True: x + attn(ln1 x) + mlp(ln2 x)
+            parallel_residual=bool(getattr(c, "use_parallel_residual", True)),
         )
 
     def convert_weights(self, sd, cfg) -> Dict[str, Any]:
@@ -336,9 +341,11 @@ class BloomPolicy(DSPolicy):
 
 
 class GPTJPolicy(DSPolicy):
-    """gptj (reference containers/gptj.py): rope (partial), gelu, untied head.
-    Note: HF GPT-J applies rotary to only ``rotary_dim`` dims; this port
-    applies full-head rope — exact parity requires rotary_dim == head_dim."""
+    """gptj (reference containers/gptj.py): parallel attention+mlp off a
+    SHARED ln_1, partial rotary over ``rotary_dim`` dims, untied head with
+    bias. HF GPT-J's interleaved (rotate-every-two) rotary is absorbed at
+    conversion: the rotary span of wq/wk is permuted even-then-odd so the
+    family's rotate-half kernel computes identical scores."""
 
     model_types = ["gptj"]
 
@@ -351,25 +358,49 @@ class GPTJPolicy(DSPolicy):
             max_seq_len=c.n_positions,
             norm="layernorm",
             position="rope",
+            rope_dim=int(getattr(c, "rotary_dim", None) or (c.n_embd // c.n_head)),
             activation="gelu",
             use_bias=True,
             qkv_bias=False,
             tie_embeddings=False,
+            parallel_residual=True,
+            shared_parallel_norm=True,
+            lm_head_bias=True,
         )
+
+    @staticmethod
+    def _rotary_perm(cfg) -> np.ndarray:
+        """Per-head feature order turning HF's interleaved rotary layout
+        into the family's rotate-half layout (evens then odds within the
+        rotary span; the tail passes through)."""
+        D, rot = cfg.head_dim, int(cfg.rope_dim or cfg.head_dim)
+        order = np.concatenate(
+            [np.arange(0, rot, 2), np.arange(1, rot, 2), np.arange(rot, D)]
+        )
+        return order
+
+    def _permute_qk(self, w, cfg) -> np.ndarray:
+        """[H, NH*D] column permutation within each head's feature block."""
+        NH, D = cfg.num_heads, cfg.head_dim
+        order = self._rotary_perm(cfg)
+        cols = w.reshape(w.shape[0], NH, D)[:, :, order]
+        return np.ascontiguousarray(cols.reshape(w.shape[0], NH * D))
 
     def convert_weights(self, sd, cfg) -> Dict[str, Any]:
         L = cfg.num_layers
-        pre = "transformer."
+        # the loader may have normalized the ForCausalLM 'transformer.' prefix
+        pre = "transformer." if any(k.startswith("transformer.h.") for k in sd) else ""
         layer = {
             "attn_norm_scale": _stack([sd[f"{pre}h.{i}.ln_1.weight"] for i in range(L)]),
             "attn_norm_bias": _stack([sd[f"{pre}h.{i}.ln_1.bias"] for i in range(L)]),
-            "wq": _stack([_t(sd[f"{pre}h.{i}.attn.q_proj.weight"]) for i in range(L)]),
-            "wk": _stack([_t(sd[f"{pre}h.{i}.attn.k_proj.weight"]) for i in range(L)]),
+            "wq": _stack([self._permute_qk(_t(sd[f"{pre}h.{i}.attn.q_proj.weight"]), cfg) for i in range(L)]),
+            "wk": _stack([self._permute_qk(_t(sd[f"{pre}h.{i}.attn.k_proj.weight"]), cfg) for i in range(L)]),
             "wv": _stack([_t(sd[f"{pre}h.{i}.attn.v_proj.weight"]) for i in range(L)]),
             "wo": _stack([_t(sd[f"{pre}h.{i}.attn.out_proj.weight"]) for i in range(L)]),
             "bo": _stack([np.zeros(cfg.hidden_size, np.float32) for _ in range(L)]),
-            # GPT-J is parallel-attention+mlp off ln_1; sequential port reuses
-            # ln_1 weights for the mlp branch (close approximation)
+            # parallel residual reads ONE shared ln_1 (shared_parallel_norm);
+            # the mlp_norm slots stay populated for tree-shape stability but
+            # are ignored by the layer
             "mlp_norm_scale": _stack([sd[f"{pre}h.{i}.ln_1.weight"] for i in range(L)]),
             "mlp_norm_bias": _stack([sd[f"{pre}h.{i}.ln_1.bias"] for i in range(L)]),
             "w_in": _stack([_t(sd[f"{pre}h.{i}.mlp.fc_in.weight"]) for i in range(L)]),
@@ -377,13 +408,17 @@ class GPTJPolicy(DSPolicy):
             "w_out": _stack([_t(sd[f"{pre}h.{i}.mlp.fc_out.weight"]) for i in range(L)]),
             "b_out": _stack([sd[f"{pre}h.{i}.mlp.fc_out.bias"] for i in range(L)]),
         }
-        return {
+        out = {
             "embed": {"tokens": np.asarray(sd[f"{pre}wte.weight"])},
             "layers": layer,
             "final_norm_scale": np.asarray(sd[f"{pre}ln_f.weight"]),
             "final_norm_bias": np.asarray(sd[f"{pre}ln_f.bias"]),
             "lm_head": _t(sd["lm_head.weight"]),
         }
+        out["lm_head_bias"] = np.asarray(
+            sd.get("lm_head.bias", np.zeros(cfg.vocab_size, np.float32))
+        )
+        return out
 
 
 class BertPolicy(DSPolicy):

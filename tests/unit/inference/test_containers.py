@@ -246,3 +246,60 @@ def test_registry_covers_reference_archs():
         "bert", "distilbert", "gpt_neo", "megatron_gpt", "megatron_gpt_moe", "clip",
     ]:
         assert policy_for(arch) is not None
+
+
+class TestGPTJInjection:
+    def test_logits_parity_with_torch(self):
+        """GPT-J exact parity: shared-ln parallel residual, PARTIAL rotary
+        (rotary_dim < head_dim) in HF's interleaved convention (absorbed by
+        the conversion-time qk permutation), biased untied head."""
+        cfg = transformers.GPTJConfig(
+            vocab_size=128,
+            n_embd=32,
+            n_layer=2,
+            n_head=4,
+            rotary_dim=4,  # head_dim=8: partial rotary exercised
+            n_positions=64,
+            resid_pdrop=0.0,
+            embd_pdrop=0.0,
+            attn_pdrop=0.0,
+        )
+        model = transformers.GPTJForCausalLM(cfg)
+        model.eval()
+        toks = np.random.RandomState(7).randint(0, 128, (2, 12)).astype(np.int64)
+        with torch.no_grad():
+            ref = model(torch.from_numpy(toks)).logits.numpy()
+
+        mesh_mod.reset_topology()
+        engine = ds.init_inference(model, dtype="fp32", replace_with_kernel_inject=True)
+        out = _logits(engine, toks)
+        np.testing.assert_allclose(out, ref, rtol=2e-3, atol=2e-3)
+
+
+class TestGPTNeoXInjection:
+    @pytest.mark.parametrize("parallel", [True, False])
+    def test_logits_parity_with_torch(self, parallel):
+        """NeoX parity in BOTH residual modes (use_parallel_residual is a
+        checkpoint-level switch) with partial rotary (rotary_pct=0.5)."""
+        cfg = transformers.GPTNeoXConfig(
+            vocab_size=128,
+            hidden_size=32,
+            num_hidden_layers=2,
+            num_attention_heads=4,
+            intermediate_size=64,
+            max_position_embeddings=64,
+            rotary_pct=0.5,
+            use_parallel_residual=parallel,
+            hidden_dropout=0.0,
+            attention_dropout=0.0,
+        )
+        model = transformers.GPTNeoXForCausalLM(cfg)
+        model.eval()
+        toks = np.random.RandomState(8).randint(0, 128, (2, 12)).astype(np.int64)
+        with torch.no_grad():
+            ref = model(torch.from_numpy(toks)).logits.numpy()
+
+        mesh_mod.reset_topology()
+        engine = ds.init_inference(model, dtype="fp32", replace_with_kernel_inject=True)
+        out = _logits(engine, toks)
+        np.testing.assert_allclose(out, ref, rtol=2e-3, atol=2e-3)

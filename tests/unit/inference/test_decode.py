@@ -103,3 +103,48 @@ def test_generate_pads_finished_rows_with_eos():
     first_eos = int(np.argmax(row0_new == eos))
     assert row0_new[first_eos] == eos
     assert (row0_new[first_eos:] == eos).all(), f"post-EOS tokens not padded: {row0_new}"
+
+
+@pytest.mark.parametrize("shared", [True, False])
+def test_decode_matches_full_forward_parallel_residual(shared):
+    """GPT-J/NeoX-flavored decode: parallel residual (shared ln_1 or dual
+    norms), PARTIAL rotary, biased untied head — the cached trajectory must
+    match the full forward exactly."""
+    from deepspeed_tpu.models.config import TransformerConfig
+
+    cfg = TransformerConfig(
+        vocab_size=128,
+        hidden_size=32,
+        num_layers=2,
+        num_heads=4,
+        max_seq_len=64,
+        norm="layernorm",
+        position="rope",
+        rope_dim=4,  # head_dim=8: partial rotary
+        activation="gelu",
+        use_bias=True,
+        qkv_bias=False,
+        tie_embeddings=False,
+        parallel_residual=True,
+        shared_parallel_norm=shared,
+        lm_head_bias=True,
+        flash_attention=False,
+        dtype="float32",
+    )
+    model = TransformerLM(cfg)
+    B, T = 2, 12
+    toks = jax.random.randint(jax.random.PRNGKey(3), (B, T), 0, cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(2), toks)
+
+    full_logits = _logits_full(model, params, toks)  # [B, T, V]
+    prefill, decode_step = build_decoder(cfg)
+    cache = init_cache(cfg, B, T, dtype=jnp.float32)
+    logits, cache = prefill(params, toks[:, :4], cache)
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(full_logits[:, 3]), rtol=1e-4, atol=1e-4
+    )
+    for t in range(4, T):
+        logits, cache = decode_step(params, toks[:, t], cache, jnp.int32(t))
+        np.testing.assert_allclose(
+            np.asarray(logits), np.asarray(full_logits[:, t]), rtol=1e-4, atol=1e-4
+        )
