@@ -85,11 +85,17 @@ def _peak_tflops_bf16() -> float:
 def _drain(engine):
     """Sync via a value at the END of the dependency chain (params feed the
     next step, so the fetch waits for every queued step); block_until_ready
-    is unreliable on the tunneled backend."""
+    is unreliable on the tunneled backend.
+
+    Fetch the SMALLEST param leaf: any output of the step program waits for
+    the whole step, but the fetch's transfer time lands inside the timed
+    window — a 14MB leaf costs ~1.5s over the tunneled link (measured
+    2026-07-31: same 20-step block read 86.5k tok/s with a 1.5KB leaf and
+    43-47k with the 14MB one; this constant was the round-4 'regression')."""
     import jax
 
     params = engine.get_params()
-    leaf = jax.tree_util.tree_leaves(params)[-1]
+    leaf = min(jax.tree_util.tree_leaves(params), key=lambda a: a.size)
     jax.device_get(leaf)
 
 
