@@ -480,7 +480,7 @@ class TransformerLM(DSModule):
         table = params["embed"]["tokens"].astype(self.dtype)
         return sparse_embedding_lookup(table, tokens, data_axes)
 
-    def _forward(self, params, tokens, rngs, train):
+    def _forward(self, params, tokens, rngs, train, pld_theta=None):
         cfg = self.config
         tokens = jnp.asarray(tokens)
         B, T = tokens.shape
@@ -503,15 +503,42 @@ class TransformerLM(DSModule):
 
         base_rng = (rngs or {}).get("dropout") if isinstance(rngs, dict) else rngs
         L = cfg.num_layers
+        pld_active = pld_theta is not None and train
+        if pld_active and base_rng is None:
+            raise ValueError(
+                "progressive layer drop needs a dropout rng (the per-layer "
+                "keep draw); pass rngs={'dropout': key} to apply()"
+            )
 
-        def body(carry, per_layer):
+        def body(carry, scanned):
             x, rng = carry
+            per_layer, layer_idx = scanned if pld_active else (scanned, None)
             if rng is not None:
                 rng, sub = jax.random.split(rng)
             else:
                 sub = None
-            x, aux = self._layer(x, per_layer, positions, sub, train)
-            return (self._activation_constraint(x), rng), aux
+
+            def run(x_in):
+                y, aux = self._layer(x_in, per_layer, positions, sub, train)
+                return self._activation_constraint(y), aux
+
+            if pld_active:
+                # PLD (reference runtime/progressive_layer_drop.py:40; Zhang &
+                # He 2020 stochastic depth): layer i bypassed with prob
+                # (i+1)/L * (1 - theta) — deeper layers dropped more; no
+                # rescale, identity passthrough, all layers active at eval.
+                # lax.cond skips the layer's compute at runtime.
+                sub, keep_rng = jax.random.split(sub)
+                keep_p = 1.0 - (layer_idx.astype(jnp.float32) + 1.0) / L * (
+                    1.0 - jnp.float32(pld_theta)
+                )
+                keep = jax.random.bernoulli(keep_rng, keep_p)
+                x_new, aux = jax.lax.cond(
+                    keep, run, lambda x_in: (x_in, jnp.zeros((), jnp.float32)), x
+                )
+            else:
+                x_new, aux = run(x)
+            return (x_new, rng), aux
 
         if cfg.remat:
             policy = getattr(jax.checkpoint_policies, cfg.remat_policy, None)
@@ -519,11 +546,18 @@ class TransformerLM(DSModule):
 
         aux_total = jnp.zeros((), jnp.float32)
         if cfg.scan_layers:
-            (x, _), aux_per_layer = jax.lax.scan(body, (x, base_rng), params["layers"])
+            xs = (
+                (params["layers"], jnp.arange(L, dtype=jnp.int32))
+                if pld_active
+                else params["layers"]
+            )
+            (x, _), aux_per_layer = jax.lax.scan(body, (x, base_rng), xs)
             aux_total = jnp.sum(aux_per_layer)
         else:
             for i in range(L):
-                (x, base_rng), aux = body((x, base_rng), self._layer_params(params, i))
+                per = self._layer_params(params, i)
+                scanned = (per, jnp.int32(i)) if pld_active else per
+                (x, base_rng), aux = body((x, base_rng), scanned)
                 aux_total = aux_total + aux
 
         if cfg.prenorm:
@@ -596,9 +630,9 @@ class TransformerLM(DSModule):
 
         return embed_fwd, layer_fwd, head_loss
 
-    def apply(self, params, batch, *, rngs=None, train: bool = True):
+    def apply(self, params, batch, *, rngs=None, train: bool = True, pld_theta=None):
         tokens, labels = _split_batch(batch)
-        logits, aux = self._forward(params, tokens, rngs, train)
+        logits, aux = self._forward(params, tokens, rngs, train, pld_theta=pld_theta)
         if labels is None:
             return logits
         loss = cross_entropy_loss(logits, labels)

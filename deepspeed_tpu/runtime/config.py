@@ -234,6 +234,15 @@ class EigenvalueConfig(DeepSpeedConfigModel):
     layer_num: int = 0
 
 
+class PLDConfig(DeepSpeedConfigModel):
+    """Progressive layer drop (reference constants.py PROGRESSIVE_LAYER_DROP;
+    runtime/progressive_layer_drop.py:40)."""
+
+    enabled: bool = False
+    theta: float = 0.5
+    gamma: float = 0.001
+
+
 class ElasticityConfig(DeepSpeedConfigModel):
     enabled: bool = False
     max_train_batch_size: int = 2000
@@ -328,6 +337,7 @@ class DeepSpeedConfig:
         self.data_types_config = DataTypesConfig(**get(C.DATA_TYPES, {}))
         self.hybrid_engine = HybridEngineConfig(**get("hybrid_engine", {}))
         self.eigenvalue_config = EigenvalueConfig(**get(C.EIGENVALUE, {}))
+        self.pld_config = PLDConfig(**get("progressive_layer_drop", {}))
         self.elasticity_config = ElasticityConfig(**get("elasticity", {}))
         self.autotuning_config = AutotuningConfig(**get("autotuning", {}))
         self.compression_config = pd.get("compression_training", {})

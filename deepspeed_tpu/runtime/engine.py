@@ -209,6 +209,18 @@ class DeepSpeedEngine:
 
             self.curriculum_scheduler = CurriculumScheduler(cl_cfg)
 
+        # progressive layer drop (reference engine.py:1773 pld_theta kwarg;
+        # here: a traced scalar through model_kwargs — stochastic depth with
+        # a lax.cond skip inside the layer loop) --------------------------
+        self.progressive_layer_drop = None
+        if self._config.pld_config.enabled:
+            from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
+
+            self.progressive_layer_drop = ProgressiveLayerDrop(
+                theta=self._config.pld_config.theta,
+                gamma=self._config.pld_config.gamma,
+            )
+
         # flops profiler (reference engine.py:574-598 wiring) -------------
         self.flops_profiler = None
         self._last_profile_args = None
@@ -523,6 +535,14 @@ class DeepSpeedEngine:
             place, batch, shardings, is_leaf=lambda x: isinstance(x, np.ndarray)
         )
 
+    def _model_kwargs(self):
+        """Per-step traced model kwargs (reference engine.py:1772-1785 kwarg
+        injection). The dict STRUCTURE is static across steps — only the
+        scalar values change — so the jitted programs never retrace."""
+        if self.progressive_layer_drop is None:
+            return {}
+        return {"pld_theta": jnp.float32(self.progressive_layer_drop.get_theta())}
+
     # ------------------------------------------------------------------
     # jitted programs
     # ------------------------------------------------------------------
@@ -538,8 +558,12 @@ class DeepSpeedEngine:
         compute_dtype = self.compute_dtype
         mixed = self.mixed_precision
 
-        def base_loss_of(params, batch, rng):
-            out = module.apply(params, batch, rngs={"dropout": rng}, train=True)
+        def base_loss_of(params, batch, rng, model_kwargs=None):
+            # model_kwargs carries per-step traced scalars (pld_theta) without
+            # retracing: the dict structure is static, the values are arrays
+            out = module.apply(
+                params, batch, rngs={"dropout": rng}, train=True, **(model_kwargs or {})
+            )
             if isinstance(out, tuple):
                 return out[0]
             return out
@@ -554,9 +578,11 @@ class DeepSpeedEngine:
             param_specs = self._param_specs
             topo = self.topology
 
-            def loss_of(params, batch, rng):
+            def loss_of(params, batch, rng, model_kwargs=None):
                 # qwZ: the stage-3 param gathers carry int8 (GSPMD boundary)
-                return base_loss_of(qwz_gather_tree(params, param_specs, topo), batch, rng)
+                return base_loss_of(
+                    qwz_gather_tree(params, param_specs, topo), batch, rng, model_kwargs
+                )
         else:
             loss_of = base_loss_of
 
@@ -564,9 +590,9 @@ class DeepSpeedEngine:
         # loss contract the step uses
         self._loss_of = loss_of
 
-        def fwd_bwd(params, grad_acc, scale, rng, batch):
+        def fwd_bwd(params, grad_acc, scale, rng, batch, model_kwargs):
             def scaled_loss(p):
-                return loss_of(p, batch, rng) * scale.astype(jnp.float32)
+                return loss_of(p, batch, rng, model_kwargs) * scale.astype(jnp.float32)
 
             loss_scaled, grads = jax.value_and_grad(scaled_loss)(params)
             new_acc = jax.tree_util.tree_map(
@@ -586,7 +612,7 @@ class DeepSpeedEngine:
             )
 
             validate_qgz_mesh(self.topology)
-            fwd_bwd = build_qgz_fwd_bwd(
+            qgz_fwd_bwd = build_qgz_fwd_bwd(
                 base_loss_of,
                 self.topology,
                 self._param_specs,
@@ -594,6 +620,14 @@ class DeepSpeedEngine:
                 self._batch_pspec,
                 qwz=qwz,
             )
+
+            def fwd_bwd(params, grad_acc, scale, rng, batch, model_kwargs):
+                if model_kwargs:  # static structure check at trace time
+                    raise NotImplementedError(
+                        "per-step model kwargs (progressive_layer_drop) are "
+                        "unsupported with zero_quantized_gradients"
+                    )
+                return qgz_fwd_bwd(params, grad_acc, scale, rng, batch)
 
         self._jit_fwd_bwd = jax.jit(fwd_bwd, donate_argnums=(1,))
 
@@ -661,13 +695,13 @@ class DeepSpeedEngine:
             self._gas_divisor == 1 and self._host_offload is None and not qgz
         )
 
-        def fused_step(params_or_none, master, opt_state, scale_state, lr, rng, batch):
+        def fused_step(params_or_none, master, opt_state, scale_state, lr, rng, batch, model_kwargs):
             params = master if params_or_none is None else params_or_none
             rng, sub = jax.random.split(rng)
             scale = scale_state.scale
 
             def scaled_loss(p):
-                return loss_of(p, batch, sub) * scale.astype(jnp.float32)
+                return loss_of(p, batch, sub, model_kwargs) * scale.astype(jnp.float32)
 
             loss_scaled, grads = jax.value_and_grad(scaled_loss)(params)
             loss = loss_scaled / scale.astype(jnp.float32)
@@ -695,8 +729,8 @@ class DeepSpeedEngine:
                     ),
                 )
             else:
-                def fp32_fused_step(master, opt_state, scale_state, lr, rng, batch):
-                    out = fused_step(None, master, opt_state, scale_state, lr, rng, batch)
+                def fp32_fused_step(master, opt_state, scale_state, lr, rng, batch, model_kwargs):
+                    out = fused_step(None, master, opt_state, scale_state, lr, rng, batch, model_kwargs)
                     return out[0], out[2], out[3], out[4], out[5], out[6], out[7]
 
                 self._jit_fused_step = jax.jit(
@@ -815,14 +849,16 @@ class DeepSpeedEngine:
                 )
             lr = self.optimizer.param_groups[0]["lr"]
             parent_rng = self._rng
+            model_kwargs = self._model_kwargs()
             if self.mixed_precision:
                 fwd_args = (
                     self._params, self._master, self._opt_state,
-                    self._scale_state, lr, self._rng, placed,
+                    self._scale_state, lr, self._rng, placed, model_kwargs,
                 )
             else:
                 fwd_args = (
                     self._master, self._opt_state, self._scale_state, lr, self._rng, placed,
+                    model_kwargs,
                 )
             if profiling:
                 self._last_profile_args = jax.tree_util.tree_map(
@@ -851,7 +887,10 @@ class DeepSpeedEngine:
             self._last_loss = loss
             self._in_forward = True
         elif self._training_mode:
-            fwd_args = (self._params, self._grad_acc, self._scale_state.scale, step_rng, placed)
+            fwd_args = (
+                self._params, self._grad_acc, self._scale_state.scale, step_rng, placed,
+                self._model_kwargs(),
+            )
             if profiling:
                 # abstract shapes only: grad_acc is donated by the call below
                 self._last_profile_args = jax.tree_util.tree_map(
@@ -888,6 +927,11 @@ class DeepSpeedEngine:
         (unscaled) loss; the streamer stashes activations for backward()."""
         from deepspeed_tpu.models.transformer import _split_batch
 
+        if self.progressive_layer_drop is not None:
+            raise NotImplementedError(
+                "progressive_layer_drop is unsupported on the param-offload "
+                "path (the layer streamer replays a fixed layer sequence)"
+            )
         tokens, labels = _split_batch(placed)
         if not self._training_mode:
             # labels=None → logits (inference head); else eval loss
@@ -1125,6 +1169,8 @@ class DeepSpeedEngine:
             )
         if self.lr_scheduler is not None and not self._overflow:
             self.lr_scheduler.step()
+        if self.progressive_layer_drop is not None:
+            self.progressive_layer_drop.update_state(self.global_steps)
         self._overflow = False
         if self.monitor is not None and self.global_steps % self._config.steps_per_print == 0:
             self._write_monitor()
@@ -1348,6 +1394,8 @@ class DeepSpeedEngine:
                 self.global_samples = state.get("global_samples", 0)
                 self.micro_steps = state.get("micro_steps", 0)
                 self.skipped_steps = state.get("skipped_steps", 0)
+                if self.progressive_layer_drop is not None:
+                    self.progressive_layer_drop.update_state(self.global_steps)
             return path, state.get("client_state", {})
         put_p = jax.jit(lambda t: t, out_shardings=self._param_shardings)
         self._params = put_p(jax.tree_util.tree_map(jnp.asarray, state["module"]))
@@ -1413,6 +1461,10 @@ class DeepSpeedEngine:
             self.global_samples = state.get("global_samples", 0)
             self.micro_steps = state.get("micro_steps", 0)
             self.skipped_steps = state.get("skipped_steps", 0)
+            if self.progressive_layer_drop is not None:
+                # theta is a pure function of global_steps — recompute it so
+                # the first resumed step drops layers like an uninterrupted run
+                self.progressive_layer_drop.update_state(self.global_steps)
         client_state = state.get("client_state", {})
         return path, client_state
 
@@ -1498,9 +1550,9 @@ class DeepSpeedEngine:
         if self._jit_debug_grad is None:
             loss_of = self._loss_of  # the step's own loss contract
 
-            def dbg(params, rng, scale, batch):
+            def dbg(params, rng, scale, batch, model_kwargs):
                 def scaled_loss(p):
-                    return loss_of(p, batch, rng) * scale.astype(jnp.float32)
+                    return loss_of(p, batch, rng, model_kwargs) * scale.astype(jnp.float32)
 
                 g = jax.grad(scaled_loss)(params)
                 return jax.tree_util.tree_map(lambda x: x.astype(jnp.float32), g)
@@ -1508,7 +1560,8 @@ class DeepSpeedEngine:
             self._jit_debug_grad = jax.jit(dbg)
         _, sub = jax.random.split(self._last_fwd_rng)
         return self._jit_debug_grad(
-            self._params, sub, self._last_fwd_scale, self._place_batch(self._last_batch)
+            self._params, sub, self._last_fwd_scale, self._place_batch(self._last_batch),
+            self._model_kwargs(),
         )
 
     def set_params(self, tree) -> None:

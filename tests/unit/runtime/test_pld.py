@@ -1,0 +1,158 @@
+"""Progressive layer drop (reference runtime/progressive_layer_drop.py:40,
+engine.py:1773): schedule math, model semantics, engine wiring."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import TransformerLM, llama_config
+from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
+
+
+def test_schedule_math():
+    pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+    assert pld.get_theta() == 1.0  # before any update
+    pld.update_state(0)
+    assert pld.get_theta() == pytest.approx(1.0)
+    thetas = []
+    for t in (10, 100, 1000, 10_000):
+        pld.update_state(t)
+        thetas.append(pld.get_theta())
+    assert all(a > b for a, b in zip(thetas, thetas[1:]))  # monotone decay
+    assert thetas[-1] == pytest.approx(0.5, abs=1e-3)  # floor = theta
+    assert pld.get_state() == {"progressive_layer_drop": True, "pld_theta": thetas[-1]}
+    pld.update_state(5)
+    assert pld.get_theta() == pytest.approx(0.5 * math.exp(-0.01 * 5) + 0.5)
+
+
+def _tiny_model(**over):
+    kw = dict(num_layers=2, remat=False, attn_dropout=0.0, hidden_dropout=0.0)
+    kw.update(over)
+    return TransformerLM(llama_config("tiny", **kw))
+
+
+def _batch(vocab, B=2, T=16, seed=0):
+    rs = np.random.RandomState(seed)
+    toks = rs.randint(0, vocab, (B, T + 1)).astype(np.int32)
+    return {"input_ids": toks[:, :-1], "labels": toks[:, 1:]}
+
+
+@pytest.mark.parametrize("scan_layers", [True, False])
+def test_theta_one_keeps_every_layer(eight_devices, scan_layers):
+    model = _tiny_model(scan_layers=scan_layers)
+    rng = jax.random.PRNGKey(0)
+    batch = _batch(model.config.vocab_size)
+    params = model.init(rng, batch)
+    base = model.apply(params, batch, rngs=jax.random.PRNGKey(1), train=True)
+    kept = model.apply(
+        params, batch, rngs=jax.random.PRNGKey(1), train=True, pld_theta=jnp.float32(1.0)
+    )
+    # cond changes XLA fusion boundaries: bit-identity is not guaranteed in
+    # bf16 compute, semantic identity is
+    np.testing.assert_allclose(np.asarray(base), np.asarray(kept), rtol=1e-4)
+
+
+def test_theta_zero_drops_deepest_layer(eight_devices):
+    # L=1, theta=0 -> keep prob 1 - 1/1*(1-0) = 0: the single layer is always
+    # bypassed, so the loss must differ from the all-layers forward and match
+    # across draws (no randomness left)
+    model = _tiny_model(num_layers=1)
+    batch = _batch(model.config.vocab_size)
+    params = model.init(jax.random.PRNGKey(0), batch)
+    full = model.apply(params, batch, rngs=jax.random.PRNGKey(1), train=True)
+    drop1 = model.apply(params, batch, rngs=jax.random.PRNGKey(1), train=True, pld_theta=jnp.float32(0.0))
+    drop2 = model.apply(params, batch, rngs=jax.random.PRNGKey(2), train=True, pld_theta=jnp.float32(0.0))
+    assert not np.allclose(np.asarray(full), np.asarray(drop1))
+    np.testing.assert_allclose(np.asarray(drop1), np.asarray(drop2), rtol=1e-6)
+
+
+def test_eval_ignores_pld(eight_devices):
+    model = _tiny_model()
+    batch = _batch(model.config.vocab_size)
+    params = model.init(jax.random.PRNGKey(0), batch)
+    base = model.apply(params, batch, rngs=None, train=False)
+    pld = model.apply(params, batch, rngs=None, train=False, pld_theta=jnp.float32(0.0))
+    np.testing.assert_allclose(np.asarray(base), np.asarray(pld), rtol=1e-6)
+
+
+def test_pld_needs_rng(eight_devices):
+    model = _tiny_model()
+    batch = _batch(model.config.vocab_size)
+    params = model.init(jax.random.PRNGKey(0), batch)
+    with pytest.raises(ValueError, match="dropout rng"):
+        model.apply(params, batch, rngs=None, train=True, pld_theta=jnp.float32(0.5))
+
+
+def test_engine_pld_trains_and_decays_theta(eight_devices):
+    model = _tiny_model()
+    engine, *_ = ds.initialize(
+        model=model,
+        config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 1},
+            "progressive_layer_drop": {"enabled": True, "theta": 0.5, "gamma": 0.1},
+        },
+    )
+    assert engine.progressive_layer_drop is not None
+    batch = _batch(model.config.vocab_size, B=16)  # micro=2 x dp=8
+    for _ in range(3):
+        loss = engine(batch)
+        engine.backward(loss)
+        engine.step()
+        assert np.isfinite(float(jax.device_get(loss)))
+    expected = 0.5 * math.exp(-0.1 * engine.global_steps) + 0.5
+    assert engine.progressive_layer_drop.get_theta() == pytest.approx(expected)
+    state = engine.progressive_layer_drop.get_state()
+    assert state["progressive_layer_drop"] is True
+
+
+def test_theta_restored_on_checkpoint_load(tmp_path, eight_devices):
+    import deepspeed_tpu.parallel.mesh as mesh_mod
+
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 1},
+        "progressive_layer_drop": {"enabled": True, "theta": 0.5, "gamma": 0.1},
+    }
+    model = _tiny_model()
+    batch = _batch(model.config.vocab_size, B=16)
+    mesh_mod.reset_topology()
+    a, *_ = ds.initialize(model=model, config=cfg)
+    for _ in range(3):
+        loss = a(batch); a.backward(loss); a.step()
+    a.save_checkpoint(str(tmp_path))
+
+    mesh_mod.reset_topology()
+    b, *_ = ds.initialize(model=_tiny_model(), config=cfg)
+    b.init_params(batch)
+    assert b.progressive_layer_drop.get_theta() == 1.0  # fresh engine
+    b.load_checkpoint(str(tmp_path))
+    # theta is a pure function of global_steps; the first resumed step must
+    # drop layers exactly like an uninterrupted run would
+    assert b.progressive_layer_drop.get_theta() == pytest.approx(
+        a.progressive_layer_drop.get_theta()
+    )
+    assert b.progressive_layer_drop.get_theta() < 1.0
+
+
+def test_engine_pld_disabled_by_default(eight_devices):
+    from tests.unit.simple_model import SimpleModel
+
+    engine, *_ = ds.initialize(
+        model=SimpleModel(),
+        config={
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+            "bf16": {"enabled": True},
+        },
+    )
+    assert engine.progressive_layer_drop is None
+    assert engine._model_kwargs() == {}

@@ -132,7 +132,7 @@ class TestEngineSparseGradients:
         engine.step()
         placed = engine._place_batch(batch)
         lr = engine.optimizer.param_groups[0]["lr"]
-        args = (engine._master, engine._opt_state, engine._scale_state, lr, engine._rng, placed)
+        args = (engine._master, engine._opt_state, engine._scale_state, lr, engine._rng, placed, {})
         txt = engine._jit_fused_step.lower(*args).compile().as_text()
         assert "all-gather" in txt
 
