@@ -1,0 +1,101 @@
+"""Monitor backend tests (reference: tests/unit/monitor/test_monitor.py).
+
+csvMonitor writes per-metric files; MonitorMaster fans out; the engine emits
+lr/train_loss events at steps_per_print boundaries.
+"""
+
+import csv
+import os
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.monitor.monitor import MonitorMaster, TensorBoardMonitor, WandbMonitor, csvMonitor
+from deepspeed_tpu.runtime.config import CSVConfig, MonitorConfig, TensorBoardConfig, WandbConfig
+from tests.unit.simple_model import SimpleModel, random_dataloader
+
+
+def _read_csv(path):
+    with open(path, newline="") as f:
+        return list(csv.reader(f))
+
+
+def test_csv_monitor_writes_rows(tmp_path):
+    mon = csvMonitor(CSVConfig(enabled=True, output_path=str(tmp_path), job_name="job"))
+    assert mon.enabled
+    mon.write_events([("Train/loss", 1.5, 0), ("Train/loss", 1.2, 1), ("Train/lr", 0.1, 0)])
+    loss = _read_csv(tmp_path / "job" / "Train_loss.csv")
+    assert loss[0] == ["step", "Train_loss"]
+    assert [r[0] for r in loss[1:]] == ["0", "1"]
+    assert float(loss[1][1]) == 1.5
+    lr = _read_csv(tmp_path / "job" / "Train_lr.csv")
+    assert len(lr) == 2
+
+
+def test_csv_monitor_disabled_writes_nothing(tmp_path):
+    mon = csvMonitor(CSVConfig(enabled=False, output_path=str(tmp_path), job_name="job"))
+    assert not mon.enabled
+    mon.write_events([("a", 1.0, 0)])
+    assert not (tmp_path / "job").exists()
+
+
+def test_master_fans_out_to_enabled_backends(tmp_path):
+    cfg = MonitorConfig(
+        tensorboard=TensorBoardConfig(enabled=False),
+        wandb=WandbConfig(enabled=False),
+        csv_monitor=CSVConfig(enabled=True, output_path=str(tmp_path), job_name="m"),
+    )
+    master = MonitorMaster(cfg)
+    assert master.enabled
+    master.write_events([("x", 2.0, 7)])
+    rows = _read_csv(tmp_path / "m" / "x.csv")
+    assert rows[1] == ["7", "2.0"]
+
+
+def test_tensorboard_monitor_degrades_without_package(tmp_path):
+    mon = TensorBoardMonitor(
+        TensorBoardConfig(enabled=True, output_path=str(tmp_path), job_name="tb")
+    )
+    try:
+        import torch.utils.tensorboard  # noqa: F401
+
+        assert mon.enabled
+        mon.write_events([("a/b", 1.0, 0)])
+        assert any((tmp_path / "tb").iterdir())
+    except ImportError:
+        assert not mon.enabled  # warned and disabled, no crash
+        mon.write_events([("a/b", 1.0, 0)])
+
+
+def test_wandb_monitor_degrades_without_package():
+    mon = WandbMonitor(WandbConfig(enabled=True, project="p"))
+    try:
+        import wandb  # noqa: F401
+    except ImportError:
+        assert not mon.enabled
+        mon.write_events([("a", 1.0, 0)])
+
+
+def test_engine_writes_monitor_events(tmp_path, eight_devices):
+    engine, *_ = ds.initialize(
+        model=SimpleModel(),
+        config={
+            "train_micro_batch_size_per_gpu": 8,
+            "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+            "bf16": {"enabled": True},
+            "steps_per_print": 1,
+            "csv_monitor": {"enabled": True, "output_path": str(tmp_path), "job_name": "run"},
+        },
+    )
+    assert engine.monitor is not None and engine.monitor.enabled
+    for batch in random_dataloader(total_samples=16, batch_size=8):
+        loss = engine(batch)
+        engine.backward(loss)
+        engine.step()
+    lr_rows = _read_csv(tmp_path / "run" / "Train_Samples_lr.csv")
+    loss_rows = _read_csv(tmp_path / "run" / "Train_Samples_train_loss.csv")
+    # one event per step (steps_per_print=1), keyed by global sample count
+    assert len(lr_rows) == 3 and len(loss_rows) == 3
+    assert float(lr_rows[1][1]) == pytest.approx(1e-2)
+    assert np.isfinite(float(loss_rows[1][1]))

@@ -1,0 +1,65 @@
+"""Multi-output model handling (reference: tests/unit/runtime/
+test_multi_output_model.py): models whose apply returns (loss, extras...) —
+the engine trains on out[0] and eval forwards surface the full tuple."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from tests.unit.simple_model import random_dataloader
+
+
+class MultiOutputModel:
+    """Returns (loss, per-sample losses, logits-like aux) from apply."""
+
+    def __init__(self, hidden_dim: int = 16):
+        self.hidden_dim = hidden_dim
+
+    def init(self, rng, batch):
+        return {"w": jax.random.normal(rng, (self.hidden_dim, self.hidden_dim)) * 0.1}
+
+    def apply(self, params, batch, rngs=None, train=True):
+        x, y = batch
+        h = x @ params["w"]
+        per_sample = jnp.mean((h - y) ** 2, axis=-1)
+        return jnp.mean(per_sample), per_sample, h
+
+
+def test_trains_on_first_output(eight_devices):
+    engine, *_ = ds.initialize(
+        model=MultiOutputModel(),
+        config={
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 1},
+        },
+    )
+    losses = []
+    for batch in random_dataloader(total_samples=40, batch_size=8):
+        loss = engine(batch)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(jax.device_get(loss)))
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]  # scalar head of the tuple drove training
+
+
+def test_eval_returns_full_tuple(eight_devices):
+    engine, *_ = ds.initialize(
+        model=MultiOutputModel(),
+        config={"train_micro_batch_size_per_gpu": 1, "bf16": {"enabled": True}},
+    )
+    batch = next(random_dataloader(total_samples=8, batch_size=8))
+    engine.init_params(batch)
+    engine.eval()
+    out = engine(batch)
+    assert isinstance(out, tuple) and len(out) == 3
+    loss, per_sample, h = out
+    assert per_sample.shape == (8,)
+    assert h.shape == (8, 16)
+    assert float(jax.device_get(loss)) == pytest.approx(
+        float(np.mean(np.asarray(jax.device_get(per_sample)))), rel=1e-6
+    )
