@@ -53,6 +53,24 @@ def initialize(
     """
     log_dist(f"deepspeed_tpu info: version={__version__}", ranks=[0])
 
+    # a live zero.Init context must not wrap engine construction; PAUSE it
+    # and restore on the way out (reference __init__.py:128
+    # shutdown_init_context + restore_init_context before returning)
+    _init_depth = zero.shutdown_init_context()
+    try:
+        return _initialize_paused(
+            args, model, optimizer, model_parameters, training_data,
+            lr_scheduler, mpu, dist_init_required, collate_fn, config,
+            config_params, loss_fn,
+        )
+    finally:
+        zero.restore_init_context(_init_depth)
+
+
+def _initialize_paused(
+    args, model, optimizer, model_parameters, training_data, lr_scheduler,
+    mpu, dist_init_required, collate_fn, config, config_params, loss_fn,
+):
     if model is None:
         raise AssertionError("deepspeed.initialize requires a model")
 

@@ -30,11 +30,15 @@ from deepspeed_tpu.runtime.zero.offload_config import (
 from deepspeed_tpu.runtime.zero.partition import ZeroPartitioner, estimate_zero_memory
 from deepspeed_tpu.runtime.zero.tiling import TiledLinear, TiledLinearReturnBias
 
-_init_ctx_active = False
+_init_ctx_depth = 0
 
 
 class Init(contextlib.AbstractContextManager):
-    """API-parity context (reference zero.Init, partition_parameters.py:709)."""
+    """API-parity context (reference zero.Init, partition_parameters.py:709).
+
+    Nesting-safe like the reference (tests/unit/runtime/zero/
+    test_zero_nesting_init.py): a depth counter, so exiting an inner
+    context leaves the outer one active."""
 
     def __init__(self, module=None, data_parallel_group=None, mem_efficient_linear=True,
                  remote_device=None, pin_memory=False, config_dict_or_path=None,
@@ -42,24 +46,39 @@ class Init(contextlib.AbstractContextManager):
         self.enabled = enabled
 
     def __enter__(self):
-        global _init_ctx_active
+        global _init_ctx_depth
         if self.enabled:
-            _init_ctx_active = True
+            _init_ctx_depth += 1
         return self
 
     def __exit__(self, *exc):
-        global _init_ctx_active
-        _init_ctx_active = False
+        global _init_ctx_depth
+        if self.enabled and _init_ctx_depth > 0:
+            _init_ctx_depth -= 1
         return False
 
 
 def is_init_context_active() -> bool:
-    return _init_ctx_active
+    return _init_ctx_depth > 0
 
 
-def shutdown_init_context() -> None:
-    global _init_ctx_active
-    _init_ctx_active = False
+def shutdown_init_context() -> int:
+    """Pause the context (reference partition_parameters.py:541 — called by
+    ``deepspeed.initialize`` so engine construction isn't nested inside a
+    live Init context). Returns the prior depth for ``restore_init_context``."""
+    global _init_ctx_depth
+    prior = _init_ctx_depth
+    _init_ctx_depth = 0
+    return prior
+
+
+def restore_init_context(depth: int) -> None:
+    """Resume a paused context (reference ``Init._enable_class`` re-patch on
+    restore): ``initialize()`` pauses around engine construction, then code
+    after it inside the same ``with zero.Init():`` block sees an active
+    context again."""
+    global _init_ctx_depth
+    _init_ctx_depth = depth
 
 
 class GatheredParameters(contextlib.AbstractContextManager):

@@ -1,0 +1,71 @@
+"""zero.Init context semantics (reference: tests/unit/runtime/zero/
+test_zero_context.py, test_zero_nesting_init.py): nesting, enabled=False,
+shutdown, and that models built inside the context train normally (under
+GSPMD the context is intent-marking — sharded init is the default)."""
+
+import deepspeed_tpu as ds
+from deepspeed_tpu import zero
+from tests.unit.simple_model import SimpleModel, random_dataloader
+
+
+def test_init_context_activates_and_deactivates():
+    assert not zero.is_init_context_active()
+    with zero.Init():
+        assert zero.is_init_context_active()
+    assert not zero.is_init_context_active()
+
+
+def test_nested_init_keeps_outer_active():
+    with zero.Init():
+        with zero.Init():
+            assert zero.is_init_context_active()
+        # inner exit must NOT deactivate the outer context
+        assert zero.is_init_context_active()
+    assert not zero.is_init_context_active()
+
+
+def test_disabled_init_is_inert():
+    with zero.Init(enabled=False):
+        assert not zero.is_init_context_active()
+    # disabled inner context must not deactivate an enabled outer one
+    with zero.Init():
+        with zero.Init(enabled=False):
+            assert zero.is_init_context_active()
+        assert zero.is_init_context_active()
+
+
+def test_shutdown_init_context_force_clears():
+    with zero.Init():
+        zero.shutdown_init_context()
+        assert not zero.is_init_context_active()
+    assert not zero.is_init_context_active()
+
+
+def test_model_built_inside_init_trains(eight_devices):
+    with zero.Init():
+        model = SimpleModel()
+        engine, *_ = ds.initialize(
+            model=model,
+            config={
+                "train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+                "bf16": {"enabled": True},
+                "zero_optimization": {"stage": 3},
+            },
+        )
+        # initialize() PAUSES the context around engine construction and
+        # restores it (reference __init__.py:128 + restore): code after it
+        # in the same with-block still sees an active context
+        assert zero.is_init_context_active()
+    assert not zero.is_init_context_active()
+    batch = next(random_dataloader(total_samples=8, batch_size=8))
+    losses = []
+    for _ in range(5):
+        loss = engine(batch)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]  # memorizing one batch must reduce loss
+    # stage 3: master weights sharded over the data axis
+    spec = engine.get_master_params()["w0"].sharding.spec
+    assert "data" in str(spec)
