@@ -1,0 +1,129 @@
+"""LoRA adapters for RLHF rollouts (DS-Chat).
+
+Counterpart of the reference's ``HybridEngineContainer`` LoRA feature
+(``deepspeed/module_inject/containers/features/hybrid_engine.py:50-80``:
+``set_lora_params`` / ``fuse_lora`` / ``unfuse_lora``, driven by
+``DeepSpeedHybridEngine.fuse_lora_weight`` at
+``deepspeed/runtime/hybrid_engine.py:141``). The reference fuses by mutating
+``param.data += scaling * left.T @ right.T`` before a rollout and subtracting
+after — an approximate restore in half precision.
+
+TPU-native design: LoRA state is a pytree mirroring the targeted weight
+leaves. Fusing is a *pure function* producing a new param tree (one einsum
+per stacked layer weight, batched over the layer dim — MXU-friendly), and
+unfusing on the hybrid engine is EXACT: the compute-dtype store is recast
+from the untouched fp32 master instead of subtracting the delta back in
+bf16.
+
+Layout: model weights here are stacked over layers — ``params["layers"][k]``
+is ``[L, in, out]`` — so a LoRA pair is ``right [L, in, r]`` and ``left
+[L, r, out]`` and the delta is ``einsum('lir,lro->lio')``. Plain 2-D leaves
+(no leading layer dim) get the unbatched pair.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# default targets: the attention projections (the DS-Chat / LoRA-paper
+# default) — callers widen to MLP weights via LoRAConfig.target_keys
+DEFAULT_TARGET_KEYS = ("wq", "wk", "wv", "wo")
+
+
+@dataclass
+class LoRAConfig:
+    rank: int = 8
+    alpha: float = 16.0
+    target_keys: Tuple[str, ...] = DEFAULT_TARGET_KEYS
+
+    @property
+    def scaling(self) -> float:
+        return self.alpha / self.rank
+
+
+def _is_matrix(leaf) -> bool:
+    return hasattr(leaf, "ndim") and leaf.ndim in (2, 3)
+
+
+def init_lora_params(params: Dict[str, Any], config: LoRAConfig, rng) -> Dict[str, Any]:
+    """LoRA state for every targeted weight: ``right`` ~ N(0, 1/r) (the
+    down-projection), ``left`` = 0 (so the adapter starts as identity —
+    standard LoRA init). Returns ``{"layers": {key: {"right", "left"}}}``
+    mirroring the model tree's targeted leaves."""
+    layers = params.get("layers", {})
+    out: Dict[str, Any] = {"layers": {}}
+    r = config.rank
+    for key in config.target_keys:
+        if key not in layers or not _is_matrix(layers[key]):
+            continue
+        w = layers[key]
+        rng, sub = jax.random.split(rng)
+        if w.ndim == 3:  # stacked [L, in, out]
+            L, d_in, d_out = w.shape
+            right = jax.random.normal(sub, (L, d_in, r), jnp.float32) / jnp.sqrt(r)
+            left = jnp.zeros((L, r, d_out), jnp.float32)
+        else:
+            d_in, d_out = w.shape
+            right = jax.random.normal(sub, (d_in, r), jnp.float32) / jnp.sqrt(r)
+            left = jnp.zeros((r, d_out), jnp.float32)
+        out["layers"][key] = {"right": right, "left": left}
+    if not out["layers"]:
+        raise ValueError(
+            f"no LoRA targets matched: target_keys={config.target_keys}, "
+            f"layer weights={[k for k, v in layers.items() if _is_matrix(v)]}"
+        )
+    return out
+
+
+def lora_delta(pair: Dict[str, Any], scaling: float, dtype=None):
+    """``scaling * right @ left`` (batched over the stacked layer dim)."""
+    right, left = pair["right"], pair["left"]
+    if right.ndim == 3:
+        delta = jnp.einsum("lir,lro->lio", right, left)
+    else:
+        delta = right @ left
+    delta = scaling * delta
+    return delta.astype(dtype) if dtype is not None else delta
+
+
+def fuse_lora_tree(params: Dict[str, Any], lora: Dict[str, Any], scaling: float) -> Dict[str, Any]:
+    """New param tree with every targeted weight replaced by
+    ``W + scaling * right @ left`` (reference ``fuse_lora``,
+    hybrid_engine.py feature :63). Pure — the input tree is untouched."""
+    new_layers = dict(params["layers"])
+    for key, pair in lora["layers"].items():
+        w = new_layers[key]
+        new_layers[key] = (
+            w.astype(jnp.float32) + lora_delta(pair, scaling)
+        ).astype(w.dtype)
+    out = dict(params)
+    out["layers"] = new_layers
+    return out
+
+
+def unfuse_lora_tree(params: Dict[str, Any], lora: Dict[str, Any], scaling: float) -> Dict[str, Any]:
+    """Inverse of ``fuse_lora_tree`` (reference ``unfuse_lora`` :72). NOTE:
+    in half precision this is an approximate restore (same as the
+    reference's ``param.data -=``); the hybrid engine restores exactly by
+    recasting from the fp32 master instead."""
+    neg = {
+        "layers": {
+            k: {"right": p["right"], "left": -p["left"]}
+            for k, p in lora["layers"].items()
+        }
+    }
+    return fuse_lora_tree(params, neg, scaling)
+
+
+def maybe_get_lora(lora: Optional[Dict[str, Any]], key: str) -> List[Any]:
+    """Reference-shaped probe (``maybe_get_lora``): ``[right, left]`` when
+    ``key`` has an adapter, else ``[]`` (scaling lives on LoRAConfig /
+    the engine, not per-pair)."""
+    if lora is None or key not in lora.get("layers", {}):
+        return []
+    pair = lora["layers"][key]
+    return [pair["right"], pair["left"]]

@@ -27,6 +27,14 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         self._generate_jit = None
         cfg = self._config.hybrid_engine
         self.max_out_tokens = cfg.max_out_tokens
+        # LoRA (reference hybrid_engine.py:69,138-157 fuse/unfuse around
+        # rollouts; containers/features/hybrid_engine.py:50-80)
+        self._lora = None
+        self._lora_scaling = 1.0
+        self._prefuse_params = None
+        self._fused_cache = None
+        self.is_lora_fused = False
+        self._jit_fuse = None
         log_dist(f"HybridEngine: max_out_tokens={self.max_out_tokens}", ranks=[0])
 
     def eval(self):
@@ -35,7 +43,101 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
 
     def train(self, mode: bool = True):
         self._in_inference_mode = not mode
+        if mode and self.is_lora_fused:
+            # the reference unfuses before training for the same reason:
+            # training math must see the base weights
+            self.unfuse_lora_weight()
         return super().train(mode)
+
+    # ------------------------------------------------------------------
+    # LoRA (DS-Chat RLHF adapters)
+    # ------------------------------------------------------------------
+    def set_lora(self, lora_params, scaling: float = 1.0) -> None:
+        """Attach adapter state (reference ``set_lora_params``): a pytree
+        from ``module_inject.lora.init_lora_params`` (or the same shape).
+        Rollouts then read ``W + scaling * right @ left`` views; training
+        weights are untouched."""
+        self._lora = lora_params
+        self._lora_scaling = float(scaling)
+        self._fused_cache = None
+
+    def configure_lora(self, rank: int = 8, alpha: float = 16.0, target_keys=None, rng=None):
+        """Create fresh adapters over the live params and attach them."""
+        from deepspeed_tpu.module_inject.lora import (
+            DEFAULT_TARGET_KEYS,
+            LoRAConfig,
+            init_lora_params,
+        )
+
+        if not self._initialized:
+            raise RuntimeError("configure_lora before engine state is initialized")
+        cfg = LoRAConfig(
+            rank=rank, alpha=alpha, target_keys=tuple(target_keys or DEFAULT_TARGET_KEYS)
+        )
+        if rng is None:
+            self._rng, rng = jax.random.split(self._rng)
+        lora = init_lora_params(self.get_params(), cfg, rng)
+        self.set_lora(lora, cfg.scaling)
+        return lora
+
+    def _fused_view(self, params):
+        from deepspeed_tpu.module_inject.lora import fuse_lora_tree
+
+        # memoize on the source tree's identity: params change every step
+        # (new arrays from the jitted step), so id() is a safe cache key —
+        # rollout loops between steps reuse one fuse instead of paying the
+        # einsum+copy per generate() call
+        cached = self._fused_cache
+        if cached is not None and cached[0] is params and cached[1] is self._lora:
+            return cached[2]
+        if self._jit_fuse is None:
+            self._jit_fuse = jax.jit(fuse_lora_tree, static_argnums=(2,))
+        view = self._jit_fuse(params, self._lora, self._lora_scaling)
+        self._fused_cache = (params, self._lora, view)
+        return view
+
+    def fuse_lora_weight(self) -> None:
+        """Swap the compute-dtype store to the fused view (reference
+        ``fuse_lora_weight`` hybrid_engine.py:141). Pure-functional fuse:
+        the pre-fuse tree is stashed, so unfusing is EXACT — no bf16
+        add/subtract drift like the reference's in-place mutation."""
+        if self._lora is None or self.is_lora_fused:
+            return
+        if not self._initialized or self._params is None:
+            raise RuntimeError("fuse_lora_weight before engine state is initialized")
+        self._prefuse_params = self._params
+        self._params = self._fused_view(self._params)
+        self.is_lora_fused = True
+
+    def unfuse_lora_weight(self) -> None:
+        """Exact restore of the pre-fuse weights (reference
+        ``unfuse_lora_weight`` hybrid_engine.py:148)."""
+        if not self.is_lora_fused:
+            return
+        self._params = self._prefuse_params
+        self._prefuse_params = None
+        self.is_lora_fused = False
+
+    def forward(self, batch):
+        if self._training_mode and self.is_lora_fused:
+            self.unfuse_lora_weight()
+        return super().forward(batch)
+
+    def save_checkpoint(self, *args, **kwargs):
+        # never persist fused weights: the module state would bake the
+        # adapter delta into the base (and diverge from the fp32 master)
+        if self.is_lora_fused:
+            log_dist("save_checkpoint: unfusing LoRA before saving", ranks=[0])
+            self.unfuse_lora_weight()
+        return super().save_checkpoint(*args, **kwargs)
+
+    def load_checkpoint(self, *args, **kwargs):
+        # drop any fuse state: the stash predates the load, and the loaded
+        # weights are unfused by construction (see save_checkpoint)
+        if self.is_lora_fused:
+            self.unfuse_lora_weight()
+        self._fused_cache = None
+        return super().load_checkpoint(*args, **kwargs)
 
     def generate(
         self,
@@ -63,15 +165,21 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         module = self.module
         self._rng, sub = jax.random.split(self._rng)
 
+        # adapters attached but not fused: roll out on a fused VIEW without
+        # touching engine state (fuse is pure, so this is free to discard)
+        rollout_params = self._params
+        if self._lora is not None and not self.is_lora_fused:
+            rollout_params = self._fused_view(self._params)
+
         from deepspeed_tpu.models.transformer import TransformerLM
 
         if isinstance(module, TransformerLM) and self._params is not None:
             from deepspeed_tpu.inference.decode import generate as kv_generate
 
-            leaf = jax.tree_util.tree_leaves(self._params["embed"])[0]
+            leaf = jax.tree_util.tree_leaves(rollout_params["embed"])[0]
             return kv_generate(
                 module.config,
-                self._params,
+                rollout_params,
                 input_ids,
                 max_new,
                 eos_token_id=eos_token_id,
@@ -92,7 +200,7 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
             self._generate_jit = {}
         return greedy_generate(
             apply_fn,
-            self._params,
+            rollout_params,
             input_ids,
             max_new,
             sub,

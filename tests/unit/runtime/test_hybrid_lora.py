@@ -1,0 +1,205 @@
+"""Hybrid-engine LoRA (reference: tests/unit/hybrid_engine/test_he_lora.py;
+containers/features/hybrid_engine.py fuse_lora/unfuse_lora): adapter init,
+fuse math, EXACT unfuse, and rollouts on fused views."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+import deepspeed_tpu.parallel.mesh as mesh_mod
+from deepspeed_tpu.models import TransformerLM, llama_config
+from deepspeed_tpu.module_inject.lora import (
+    LoRAConfig,
+    fuse_lora_tree,
+    init_lora_params,
+    lora_delta,
+    maybe_get_lora,
+    unfuse_lora_tree,
+)
+
+
+def _params(seed=0):
+    cfg = llama_config("tiny", num_layers=2, remat=False)
+    model = TransformerLM(cfg)
+    rs = np.random.RandomState(seed)
+    toks = rs.randint(0, cfg.vocab_size, (2, 17)).astype(np.int32)
+    batch = {"input_ids": toks[:, :-1], "labels": toks[:, 1:]}
+    return model, model.init(jax.random.PRNGKey(0), batch), batch
+
+
+class TestLoRAMath:
+    def test_init_shapes_and_identity(self):
+        _, params, _ = _params()
+        cfg = LoRAConfig(rank=4, alpha=8.0)
+        lora = init_lora_params(params, cfg, jax.random.PRNGKey(1))
+        assert set(lora["layers"]) == {"wq", "wk", "wv", "wo"}
+        L, H, O = params["layers"]["wq"].shape
+        assert lora["layers"]["wq"]["right"].shape == (L, H, 4)
+        assert lora["layers"]["wq"]["left"].shape == (L, 4, O)
+        # left starts at zero: fusing is the identity
+        fused = fuse_lora_tree(params, lora, cfg.scaling)
+        np.testing.assert_array_equal(
+            np.asarray(fused["layers"]["wq"]), np.asarray(params["layers"]["wq"])
+        )
+
+    def test_fuse_matches_manual_product(self):
+        _, params, _ = _params()
+        cfg = LoRAConfig(rank=4, alpha=8.0, target_keys=("wq",))
+        lora = init_lora_params(params, cfg, jax.random.PRNGKey(1))
+        rs = np.random.RandomState(2)
+        lora["layers"]["wq"]["left"] = jnp.asarray(
+            rs.randn(*lora["layers"]["wq"]["left"].shape).astype(np.float32) * 0.1
+        )
+        fused = fuse_lora_tree(params, lora, cfg.scaling)
+        manual = np.asarray(params["layers"]["wq"], np.float32) + cfg.scaling * np.einsum(
+            "lir,lro->lio",
+            np.asarray(lora["layers"]["wq"]["right"], np.float32),
+            np.asarray(lora["layers"]["wq"]["left"], np.float32),
+        )
+        np.testing.assert_allclose(
+            np.asarray(fused["layers"]["wq"], np.float32), manual, rtol=1e-4, atol=1e-6
+        )
+        # untargeted leaves are the SAME buffers, not copies
+        assert fused["layers"]["wo"] is params["layers"]["wo"]
+
+    def test_unfuse_inverts_in_fp32(self):
+        _, params, _ = _params()
+        cfg = LoRAConfig(rank=4, alpha=8.0, target_keys=("wq", "wo"))
+        lora = init_lora_params(params, cfg, jax.random.PRNGKey(1))
+        for k in ("wq", "wo"):
+            rs = np.random.RandomState(hash(k) % 2**31)
+            lora["layers"][k]["left"] = jnp.asarray(
+                rs.randn(*lora["layers"][k]["left"].shape).astype(np.float32) * 0.1
+            )
+        restored = unfuse_lora_tree(fuse_lora_tree(params, lora, cfg.scaling), lora, cfg.scaling)
+        for k in ("wq", "wo"):
+            np.testing.assert_allclose(
+                np.asarray(restored["layers"][k]),
+                np.asarray(params["layers"][k]),
+                atol=1e-6,
+            )
+
+    def test_delta_dtype_and_probe(self):
+        _, params, _ = _params()
+        cfg = LoRAConfig(rank=2)
+        lora = init_lora_params(params, cfg, jax.random.PRNGKey(1))
+        d = lora_delta(lora["layers"]["wq"], cfg.scaling, dtype=jnp.bfloat16)
+        assert d.dtype == jnp.bfloat16
+        assert len(maybe_get_lora(lora, "wq")) == 2
+        assert maybe_get_lora(lora, "w_gate") == []
+        assert maybe_get_lora(None, "wq") == []
+
+    def test_no_targets_raises(self):
+        _, params, _ = _params()
+        with pytest.raises(ValueError, match="no LoRA targets"):
+            init_lora_params(params, LoRAConfig(target_keys=("nope",)), jax.random.PRNGKey(0))
+
+
+class TestHybridEngineLoRA:
+    def _engine(self):
+        mesh_mod.reset_topology()
+        model, _, batch = _params()
+        engine, *_ = ds.initialize(
+            model=model,
+            config={
+                "train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+                "bf16": {"enabled": True},
+                "zero_optimization": {"stage": 1},
+                "hybrid_engine": {"enabled": True, "max_out_tokens": 8},
+            },
+        )
+        full = np.tile(np.asarray(batch["input_ids"]), (4, 1))
+        engine.init_params({"input_ids": full, "labels": full})
+        return engine, model
+
+    def test_fuse_unfuse_exact(self, eight_devices):
+        engine, _ = self._engine()
+        lora = engine.configure_lora(rank=4, alpha=8.0)
+        # nonzero adapter so fusing actually changes weights
+        lora["layers"]["wq"]["left"] = jnp.asarray(
+            np.random.RandomState(0).randn(*lora["layers"]["wq"]["left"].shape).astype(np.float32)
+        )
+        engine.set_lora(lora, 2.0)
+        before = np.asarray(jax.device_get(engine.get_params()["layers"]["wq"]))
+        engine.fuse_lora_weight()
+        assert engine.is_lora_fused
+        fused = np.asarray(jax.device_get(engine.get_params()["layers"]["wq"]))
+        assert not np.array_equal(fused, before)
+        engine.unfuse_lora_weight()
+        after = np.asarray(jax.device_get(engine.get_params()["layers"]["wq"]))
+        np.testing.assert_array_equal(after, before)  # EXACT, not approximate
+
+    def test_rollout_uses_fused_view_without_state_flip(self, eight_devices):
+        engine, model = self._engine()
+        prompts = np.zeros((1, 4), np.int32)
+        base = np.asarray(engine.generate(prompts, max_new_tokens=6))
+        lora = engine.configure_lora(rank=4, alpha=8.0)
+        big = np.random.RandomState(1).randn(*lora["layers"]["wq"]["left"].shape)
+        lora["layers"]["wq"]["left"] = jnp.asarray(big.astype(np.float32))
+        engine.set_lora(lora, 4.0)
+        adapted = np.asarray(engine.generate(prompts, max_new_tokens=6))
+        assert not engine.is_lora_fused  # view only, no state flip
+        assert not np.array_equal(adapted, base)  # adapter changed the rollout
+        # detaching restores the base behavior exactly
+        engine._lora = None
+        again = np.asarray(engine.generate(prompts, max_new_tokens=6))
+        np.testing.assert_array_equal(again, base)
+
+    def test_checkpoint_never_persists_fused_weights(self, tmp_path, eight_devices):
+        engine, _ = self._engine()
+        lora = engine.configure_lora(rank=4, alpha=8.0)
+        lora["layers"]["wq"]["left"] = jnp.asarray(
+            np.random.RandomState(0).randn(*lora["layers"]["wq"]["left"].shape).astype(np.float32)
+        )
+        engine.set_lora(lora, 2.0)
+        base = np.asarray(jax.device_get(engine.get_params()["layers"]["wq"]))
+        engine.fuse_lora_weight()
+        engine.save_checkpoint(str(tmp_path))  # must auto-unfuse first
+        assert not engine.is_lora_fused
+        engine.fuse_lora_weight()
+        engine.load_checkpoint(str(tmp_path))  # must reset fuse state
+        assert not engine.is_lora_fused
+        loaded = np.asarray(jax.device_get(engine.get_params()["layers"]["wq"]))
+        np.testing.assert_array_equal(loaded, base)
+
+    def test_fuse_before_init_raises(self, eight_devices):
+        mesh_mod.reset_topology()
+        model, _, _ = _params()
+        engine, *_ = ds.initialize(
+            model=model,
+            config={
+                "train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+                "bf16": {"enabled": True},
+                "hybrid_engine": {"enabled": True, "max_out_tokens": 8},
+            },
+        )
+        engine.set_lora({"layers": {}}, 1.0)
+        with pytest.raises(RuntimeError, match="before engine state"):
+            engine.fuse_lora_weight()
+
+    def test_fused_view_is_cached_between_rollouts(self, eight_devices):
+        engine, _ = self._engine()
+        engine.configure_lora(rank=2)
+        v1 = engine._fused_view(engine._params)
+        v2 = engine._fused_view(engine._params)
+        assert v1 is v2  # same params + adapter: no recompute
+
+    def test_training_auto_unfuses(self, eight_devices):
+        engine, model = self._engine()
+        lora = engine.configure_lora(rank=2)
+        engine.set_lora(lora, 1.0)
+        engine.fuse_lora_weight()
+        assert engine.is_lora_fused
+        engine.train()
+        assert not engine.is_lora_fused
+        rs = np.random.RandomState(0)
+        toks = rs.randint(0, model.config.vocab_size, (8, 17)).astype(np.int32)
+        batch = {"input_ids": toks[:, :-1], "labels": toks[:, 1:]}
+        loss = engine(batch)
+        engine.backward(loss)
+        engine.step()
+        assert np.isfinite(float(jax.device_get(loss)))
