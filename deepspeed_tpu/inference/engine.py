@@ -39,10 +39,29 @@ class InferenceEngine:
     def __init__(self, model, config: Optional[DeepSpeedInferenceConfig] = None):
         self._config = config or DeepSpeedInferenceConfig()
         self.topology = get_topology()
+        # the engine owns TP-group creation (reference
+        # _create_model_parallel_group, inference/engine.py:217): when the
+        # config asks for tp_size and the live topology has no model axis,
+        # rebuild the mesh as model=tp_size x data=rest
+        tp_req = int(self._config.tensor_parallel.tp_size or 1)
+        if tp_req > 1 and self.topology.get_model_parallel_world_size() == 1:
+            import jax as _jax
+
+            from deepspeed_tpu.parallel.mesh import MeshConfig, initialize_topology
+
+            n = len(_jax.devices())
+            if n % tp_req != 0:
+                raise ValueError(
+                    f"tp_size={tp_req} does not divide the {n} visible devices"
+                )
+            self.topology = initialize_topology(
+                MeshConfig(model=tp_req, data=n // tp_req)
+            )
         self.mesh = self.topology.mesh
         self.dtype = _DTYPES[self._config.dtype]
         self._params = None
         self._jit_forward = None
+        self._cached_tp_rules = None
         self._rng = jax.random.PRNGKey(0)
         self._ds_config = None  # TransformerConfig when kernel-injected
         # ZeRO-Inference (reference engine.py:1499-1520: stage-3 offload
@@ -67,6 +86,17 @@ class InferenceEngine:
             injected = True
         else:
             self.module = wrap_module(model)
+        # checkpoint handed to init_inference (reference engine.py:406):
+        # a path string — engine-format dir, or an mp-checkpoint manifest
+        ckpt = self._config.checkpoint
+        if isinstance(ckpt, str):
+            self._load_checkpoint(ckpt)
+        elif ckpt is not None:
+            raise NotImplementedError(
+                "init_inference checkpoint= takes a path string here (an "
+                "engine checkpoint dir or an mp-checkpoint manifest); the "
+                "reference's dict descriptor form is not supported"
+            )
         log_dist(
             f"InferenceEngine: dtype={self._config.dtype} "
             f"tp_size={self._config.tensor_parallel.tp_size} kernel_inject={injected}",
@@ -113,6 +143,12 @@ class InferenceEngine:
         + expert inference groups (``deepspeed/inference/engine.py:217,230``),
         expressed as GSPMD placements instead of process groups."""
         if self._zero_config is not None:
+            if self._config.save_mp_checkpoint_path:
+                log_dist(
+                    "save_mp_checkpoint_path is ignored under ZeRO-Inference "
+                    "offload (weights live in the layer stream, not HBM)",
+                    ranks=[0],
+                )
             self._init_param_stream(params)
             return
         cast = jax.tree_util.tree_map(
@@ -140,17 +176,12 @@ class InferenceEngine:
             cast = jax.tree_util.tree_map(quant_leaf, cast)
         tp = self.topology.get_model_parallel_world_size() > 1
         ep = self.topology.axis_size("expert") > 1
+        self._cached_tp_rules = None
         if tp or ep:
             from jax.sharding import NamedSharding, PartitionSpec
 
-            tp_rules = None
-            if hasattr(self.module, "tp_partition_rules"):
-                # model-family rules carry both 'model' and 'expert' axes
-                tp_rules = self.module.tp_partition_rules(cast)
-            if tp_rules is None:
-                from deepspeed_tpu.module_inject.auto_tp import AutoTP
-
-                tp_rules = AutoTP().partition_specs(cast)
+            tp_rules = self._tp_rules(cast)
+            self._cached_tp_rules = tp_rules  # save_mp_checkpoint reuses this
             shardings = jax.tree_util.tree_map(
                 lambda s: NamedSharding(self.mesh, s),
                 tp_rules,
@@ -159,6 +190,50 @@ class InferenceEngine:
             cast = jax.device_put(cast, shardings)
         self._params = cast
         self._jit_forward = None
+        if self._config.save_mp_checkpoint_path:
+            # reference inference/engine.py:406: persist the sharded layout
+            # the moment the weights are resident, so later engines load
+            # pre-split files
+            self.save_mp_checkpoint(self._config.save_mp_checkpoint_path)
+
+    def _tp_rules(self, params):
+        """PartitionSpec tree for the weights: model-family rules when the
+        module provides them (carry 'model' and 'expert' axes), else the
+        AutoTP walk (reference module_inject/auto_tp.py:170)."""
+        tp_rules = None
+        if hasattr(self.module, "tp_partition_rules"):
+            tp_rules = self.module.tp_partition_rules(params)
+        if tp_rules is None:
+            from deepspeed_tpu.module_inject.auto_tp import AutoTP
+
+            tp_rules = AutoTP().partition_specs(params)
+        return tp_rules
+
+    def save_mp_checkpoint(self, save_path: str, tag: str = "ds-inference") -> str:
+        """Write a pre-sharded TP inference checkpoint + manifest (reference
+        ``save_mp_checkpoint_path``, inference/engine.py:406). Returns the
+        manifest path; load it back via ``init_inference(model,
+        checkpoint=<manifest>)`` or ``load_checkpoint``."""
+        if self._param_stream is not None:
+            raise NotImplementedError(
+                "save_mp_checkpoint is unsupported under ZeRO-Inference "
+                "offload: the weights live in the layer stream, not HBM"
+            )
+        if self._params is None:
+            raise RuntimeError("save_mp_checkpoint before weights are set")
+        from deepspeed_tpu.inference.mp_checkpoint import save_mp_checkpoint
+
+        rules = self._cached_tp_rules
+        if rules is None:
+            rules = self._tp_rules(self._params)
+        tp_size = max(1, self.topology.get_model_parallel_world_size())
+        return save_mp_checkpoint(
+            self._params,
+            rules,
+            save_path,
+            tag=tag,
+            tp_size=tp_size,
+        )
 
     def init_params(self, batch, rng=None) -> None:
         if rng is not None:
@@ -167,6 +242,13 @@ class InferenceEngine:
         self.set_params(params)
 
     def _load_checkpoint(self, load_dir: str) -> None:
+        from deepspeed_tpu.inference.mp_checkpoint import is_mp_checkpoint, load_mp_checkpoint
+
+        if is_mp_checkpoint(load_dir):
+            # pre-sharded layout (manifest json or its directory)
+            params, _ = load_mp_checkpoint(load_dir)
+            self.set_params(params)
+            return
         from deepspeed_tpu.runtime.checkpoint_engine.orbax_checkpoint_engine import OrbaxCheckpointEngine
 
         state = OrbaxCheckpointEngine().load(load_dir)

@@ -1,0 +1,116 @@
+"""Pre-sharded inference checkpoints (reference: tests/unit/inference/
+test_checkpoint_sharding.py; save_mp_checkpoint_path at
+deepspeed/inference/engine.py:406): shard files split model-axis leaves,
+the manifest drives reassembly, and an engine started from the manifest
+produces identical logits."""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import deepspeed_tpu as ds
+import deepspeed_tpu.parallel.mesh as mesh_mod
+from deepspeed_tpu.inference.mp_checkpoint import (
+    MANIFEST_NAME,
+    is_mp_checkpoint,
+    load_mp_checkpoint,
+    save_mp_checkpoint,
+)
+
+
+class TestLayout:
+    def test_roundtrip_with_sharded_and_replicated_leaves(self, tmp_path):
+        rs = np.random.RandomState(0)
+        params = {
+            "embed": {"tokens": rs.randn(16, 8).astype(np.float32)},
+            "layers": {
+                "wq": rs.randn(2, 8, 12).astype(np.float32),
+                "norm": rs.randn(2, 8).astype(np.float32),
+            },
+        }
+        specs = {
+            "embed": {"tokens": None},
+            "layers": {"wq": P(None, None, "model"), "norm": None},
+        }
+        mpath = save_mp_checkpoint(params, specs, str(tmp_path), tag="t", tp_size=4)
+        assert os.path.basename(mpath) == MANIFEST_NAME
+        with open(mpath) as f:
+            manifest = json.load(f)
+        assert manifest["tp_size"] == 4
+        assert manifest["shard_dims"] == {"layers/wq": 2}
+        # each tp file holds a 12/4-wide slice of wq and nothing replicated
+        with np.load(tmp_path / manifest["tp"][1]) as z:
+            assert z["layers|wq"].shape == (2, 8, 3)
+            assert list(z.files) == ["layers|wq"]
+        with np.load(tmp_path / manifest["non_tp"]) as z:
+            assert set(z.files) == {"embed|tokens", "layers|norm"}
+
+        loaded, _ = load_mp_checkpoint(mpath)
+        for path in ("embed", "layers"):
+            for k, v in params[path].items():
+                np.testing.assert_array_equal(loaded[path][k], v)
+
+    def test_indivisible_leaf_stays_replicated(self, tmp_path):
+        params = {"w": np.arange(10, dtype=np.float32).reshape(2, 5)}
+        specs = {"w": P(None, "model")}
+        mpath = save_mp_checkpoint(params, specs, str(tmp_path), tp_size=4)
+        with open(mpath) as f:
+            manifest = json.load(f)
+        assert manifest["shard_dims"] == {}  # 5 % 4 != 0: kept whole
+        loaded, _ = load_mp_checkpoint(str(tmp_path))
+        np.testing.assert_array_equal(loaded["w"], params["w"])
+
+    def test_is_mp_checkpoint_detection(self, tmp_path):
+        assert not is_mp_checkpoint(str(tmp_path))
+        save_mp_checkpoint({"w": np.ones((2, 2), np.float32)}, {"w": None}, str(tmp_path))
+        assert is_mp_checkpoint(str(tmp_path))
+        assert is_mp_checkpoint(os.path.join(tmp_path, MANIFEST_NAME))
+
+
+class TestEngineFlow:
+    def _model(self):
+        from deepspeed_tpu.models import TransformerLM, llama_config
+
+        return TransformerLM(llama_config("tiny", num_layers=2, remat=False))
+
+    def test_save_load_identical_logits(self, tmp_path, eight_devices):
+        mesh_mod.reset_topology()
+        model = self._model()
+        engine = ds.init_inference(model, dtype="bf16", tensor_parallel={"tp_size": 2})
+        toks = np.random.RandomState(0).randint(0, model.config.vocab_size, (2, 16)).astype(np.int32)
+        engine.init_params(toks)
+        ref_logits = np.asarray(jax.device_get(engine(toks)), np.float32)
+        mpath = engine.save_mp_checkpoint(str(tmp_path))
+        with open(mpath) as f:
+            manifest = json.load(f)
+        assert manifest["tp_size"] == 2
+        assert manifest["shard_dims"], "TP=2 must shard at least the projections"
+
+        # fresh engine boots straight from the manifest (init_inference
+        # checkpoint= path, reference engine.py:406)
+        mesh_mod.reset_topology()
+        engine2 = ds.init_inference(
+            self._model(), dtype="bf16", tensor_parallel={"tp_size": 2}, checkpoint=mpath
+        )
+        logits2 = np.asarray(jax.device_get(engine2(toks)), np.float32)
+        np.testing.assert_allclose(logits2, ref_logits, rtol=2e-2, atol=1e-3)
+
+    def test_auto_save_via_config_path(self, tmp_path, eight_devices):
+        mesh_mod.reset_topology()
+        model = self._model()
+        engine = ds.init_inference(
+            model, dtype="bf16", save_mp_checkpoint_path=str(tmp_path)
+        )
+        toks = np.random.RandomState(0).randint(0, model.config.vocab_size, (2, 16)).astype(np.int32)
+        engine.init_params(toks)  # set_params triggers the write
+        assert os.path.isfile(os.path.join(tmp_path, MANIFEST_NAME))
+
+    def test_save_before_weights_raises(self, eight_devices):
+        mesh_mod.reset_topology()
+        engine = ds.init_inference(self._model(), dtype="bf16")
+        with pytest.raises(RuntimeError, match="before weights"):
+            engine.save_mp_checkpoint("/tmp/nope")

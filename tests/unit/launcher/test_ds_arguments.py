@@ -1,0 +1,45 @@
+"""CLI argument helpers (reference: tests/unit/launcher/test_ds_arguments.py):
+add_config_arguments wires --deepspeed/--deepspeed_config plus the hidden
+legacy --deepscale aliases onto a user parser."""
+
+import argparse
+
+import deepspeed_tpu as ds
+
+
+def _parser():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num_epochs", type=int)
+    return ds.add_config_arguments(parser)
+
+
+def test_no_ds_args():
+    args = _parser().parse_args(["--num_epochs", "2"])
+    assert args.num_epochs == 2
+    assert args.deepspeed is False
+    assert args.deepspeed_config is None
+
+
+def test_core_deepspeed_arguments():
+    args = _parser().parse_args(
+        ["--num_epochs", "2", "--deepspeed", "--deepspeed_config", "foo.json"]
+    )
+    assert args.deepspeed is True
+    assert args.deepspeed_config == "foo.json"
+
+
+def test_deepspeed_flag_alone():
+    args = _parser().parse_args(["--deepspeed"])
+    assert args.deepspeed is True
+    assert args.deepspeed_config is None
+
+
+def test_legacy_deepscale_aliases_exist():
+    args = _parser().parse_args(["--deepscale", "--deepscale_config", "bar.json"])
+    assert args.deepscale is True
+    assert args.deepscale_config == "bar.json"
+
+
+def test_returns_same_parser():
+    parser = argparse.ArgumentParser()
+    assert ds.add_config_arguments(parser) is parser

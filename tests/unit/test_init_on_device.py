@@ -1,0 +1,37 @@
+"""OnDevice construction context (reference: tests/unit/utils/
+test_init_on_device.py): placement hint for model building; 'meta' leaves
+placement untouched (abstract init goes through jax.eval_shape)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu as ds
+
+
+def test_on_device_places_arrays():
+    target = jax.devices()[1] if len(jax.devices()) > 1 else jax.devices()[0]
+    with ds.OnDevice(dtype=jnp.bfloat16, device=str(target)):
+        x = jnp.ones((4, 4))
+    assert target in x.devices()
+
+
+def test_on_device_platform_name():
+    with ds.OnDevice(dtype=jnp.bfloat16, device="cpu"):
+        x = jnp.ones((2,))
+    assert next(iter(x.devices())).platform == "cpu"
+
+
+def test_meta_device_is_inert():
+    before = jnp.ones((2,)).devices()
+    with ds.OnDevice(dtype=jnp.bfloat16, device="meta"):
+        # meta builds use eval_shape: no memory, no placement change
+        shape = jax.eval_shape(lambda: jnp.zeros((8, 8), jnp.bfloat16))
+        x = jnp.ones((2,))
+    assert shape.shape == (8, 8) and shape.dtype == jnp.bfloat16
+    assert x.devices() == before
+
+
+def test_disabled_context_is_inert():
+    with ds.OnDevice(dtype=None, device="cpu", enabled=False) as ctx:
+        assert ctx._ctx is None
