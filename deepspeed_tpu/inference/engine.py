@@ -69,6 +69,10 @@ class InferenceEngine:
         # stream through HBM per layer — capacity over latency
         self._param_stream = None
         self._zero_config = self._parse_zero_inference()
+        # model profiling (reference engine.py:167 profile_model_time,
+        # :518 model_times): per-forward wall latency, drained at read
+        self.model_profile_enabled = False
+        self._model_times = []
 
         injected = False
         if self._config.replace_with_kernel_inject and _is_hf_model(model):
@@ -257,8 +261,35 @@ class InferenceEngine:
 
     load_checkpoint = _load_checkpoint
 
+    def profile_model_time(self, use_cuda_events: bool = True) -> None:  # noqa: ARG002
+        """Record per-forward latency (reference engine.py:167; cuda events
+        map onto a device-sync'd wall clock here)."""
+        self.model_profile_enabled = True
+
+    def model_times(self):
+        """Collected per-forward latencies, cleared on read (reference
+        engine.py:518)."""
+        assert self.model_profile_enabled, "model profiling is not enabled"
+        times = self._model_times
+        self._model_times = []
+        return times
+
     # --- forward --------------------------------------------------------
     def forward(self, *inputs, **kwargs):
+        if self.model_profile_enabled:
+            import time as _time
+
+            t0 = _time.perf_counter()
+            out = self._forward_impl(*inputs, **kwargs)
+            # close the async dispatch window: wait on one output element
+            leaf = jax.tree_util.tree_leaves(out)[0]
+            if hasattr(leaf, "ravel"):
+                jax.device_get(jnp.ravel(leaf)[:1])
+            self._model_times.append(_time.perf_counter() - t0)
+            return out
+        return self._forward_impl(*inputs, **kwargs)
+
+    def _forward_impl(self, *inputs, **kwargs):
         if self._zero_config is not None:
             batch = inputs[0] if len(inputs) == 1 else (inputs if inputs else kwargs)
             if self._param_stream is None:
@@ -284,7 +315,20 @@ class InferenceEngine:
     __call__ = forward
 
     # --- generation -----------------------------------------------------
-    def generate(
+    def generate(self, *args, **kwargs):
+        if not self.model_profile_enabled:
+            return self._generate_impl(*args, **kwargs)
+        import time as _time
+
+        t0 = _time.perf_counter()
+        out = self._generate_impl(*args, **kwargs)
+        np.asarray(out[..., -1:])  # drain: wait for the last emitted token
+        # one entry per generate call (the reference records per-token
+        # kernel times; the whole decode is one program here)
+        self._model_times.append(_time.perf_counter() - t0)
+        return out
+
+    def _generate_impl(
         self,
         input_ids,
         max_new_tokens: int = 32,

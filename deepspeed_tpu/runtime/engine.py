@@ -147,7 +147,18 @@ class DeepSpeedEngine:
                 )
         self._apply_mics_mesh()
         self._validate_zeropp_config()
-        self.topology: Topology = get_topology() if _topology_matches(self._config) else initialize_topology(
+        # a GROUPS-established topology (utils.groups.initialize before
+        # deepspeed.initialize — the reference's pre-created process groups)
+        # wins when this config doesn't ask for a specific mesh. Leftover
+        # topologies from unrelated engines are NOT adopted: a default-mesh
+        # training run must not inherit, say, an inference TP mesh.
+        live = _live_topology()
+        adopt = _topology_matches(self._config) or (
+            not _config_requests_mesh(self._config)
+            and live is not None
+            and getattr(live, "user_established", False)
+        )
+        self.topology: Topology = get_topology() if adopt else initialize_topology(
             self._config.mesh_config
         )
         self.mesh = self.topology.mesh
@@ -1670,6 +1681,20 @@ def _dict_to_namedtuple(d, cls):
         v = d[f]
         vals.append(v)
     return cls(*vals)
+
+
+def _live_topology():
+    from deepspeed_tpu.parallel import mesh as mesh_mod
+
+    return mesh_mod._TOPOLOGY
+
+
+def _config_requests_mesh(config: DeepSpeedConfig) -> bool:
+    """True when the config names a mesh shape explicitly (data > 0, or any
+    other axis above its size-1 default); all-default means 'derive' and
+    defers to a live topology."""
+    md = config.mesh_config.model_dump()
+    return md.get("data", 0) > 0 or any(v > 1 for k, v in md.items() if k != "data")
 
 
 def _topology_matches(config: DeepSpeedConfig) -> bool:

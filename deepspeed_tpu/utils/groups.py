@@ -1,0 +1,154 @@
+"""Process-group accessor API (reference: ``deepspeed/utils/groups.py``
+:51-528 — ``initialize(ep_size, mpu)`` plus the ``_get_*_parallel_group``
+family).
+
+TPU-native design: the reference materializes torch.distributed process
+groups; here every "group" is a VIEW over an axis of the global device mesh
+(``parallel/mesh.py``). The returned handles carry ``.size``/``.ranks`` —
+the duck-type the comm facade's ``get_world_size(group=...)`` /
+``get_all_ranks_from_group`` probe — and ``.axis`` for sharding-aware
+callers. Collectives over a group are expressed by sharding over its axis;
+no group construction or rendezvous happens here.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+from deepspeed_tpu.parallel.mesh import MeshConfig, get_topology, initialize_topology
+
+
+@dataclass(frozen=True)
+class AxisGroup:
+    """A mesh-axis view with the comm-facade group duck-type."""
+
+    axis: Tuple[str, ...]
+    size: int
+
+    @property
+    def ranks(self):
+        return list(range(self.size))
+
+    def __len__(self) -> int:
+        return self.size
+
+
+def _axis_group(*axes: str) -> AxisGroup:
+    topo = get_topology()
+    size = 1
+    for a in axes:
+        size *= topo.axis_size(a)
+    return AxisGroup(axis=axes, size=size)
+
+
+def initialize(ep_size: int = 1, mpu=None) -> None:  # noqa: ARG001
+    """Establish the expert axis (reference groups.py:51 — creates expert +
+    expert-data groups INSIDE the existing parallel layout). The expert
+    axis is carved out of the data axis; model/pipe/sequence/data_outer
+    axes are preserved. An existing expert axis is validated instead.
+
+    The resulting topology is marked groups-established so a later
+    ``ds.initialize`` with no explicit mesh adopts it (the training engine
+    otherwise rebuilds its own derived mesh)."""
+    if ep_size <= 1:
+        return
+    topo = get_topology()
+    if topo.axis_size("expert") == ep_size:
+        topo.user_established = True
+        return
+    if topo.axis_size("expert") != 1:
+        raise ValueError(
+            f"expert axis already sized {topo.axis_size('expert')}; "
+            f"cannot re-initialize to ep_size={ep_size}"
+        )
+    old = topo.config
+    if old.data % ep_size != 0:
+        raise ValueError(
+            f"ep_size={ep_size} does not divide the data axis ({old.data}); "
+            "expert groups are carved from data parallelism"
+        )
+    new_topo = initialize_topology(
+        MeshConfig(
+            pipe=old.pipe,
+            data_outer=old.data_outer,
+            data=old.data // ep_size,
+            expert=ep_size,
+            sequence=old.sequence,
+            model=old.model,
+        )
+    )
+    new_topo.user_established = True
+
+
+# --- accessors (reference groups.py:282-528) -------------------------------
+def _get_data_parallel_group() -> AxisGroup:
+    """Dense-param DP group: data_outer x data x expert — EP groups are
+    carved INSIDE data parallelism (reference groups.py; matches
+    Topology.get_data_parallel_world_size)."""
+    return _axis_group("data_outer", "data", "expert")
+
+
+def _get_model_parallel_group() -> AxisGroup:
+    return _axis_group("model")
+
+
+def _get_expert_parallel_group(group_name: Optional[str] = None) -> AxisGroup:  # noqa: ARG001
+    return _axis_group("expert")
+
+
+def _get_expert_data_parallel_group(group_name: Optional[str] = None) -> AxisGroup:  # noqa: ARG001
+    """DP ranks holding the same expert shard (reference expert-data
+    groups, groups.py:113): the inner data axis — matches
+    Topology.get_expert_data_parallel_world_size."""
+    return _axis_group("data")
+
+
+def _get_sequence_parallel_group() -> AxisGroup:
+    return _axis_group("sequence")
+
+
+def _get_sequence_data_parallel_group() -> AxisGroup:
+    return _axis_group("sequence", "data_outer", "data", "expert")
+
+
+def _get_max_expert_size_name() -> str:
+    return f"ep_size_{_axis_group('expert').size}"
+
+
+# public world-size / rank helpers (reference :373-465). Rank within a mesh
+# axis is a per-device notion under SPMD; the process-level rank is 0 in
+# single-controller runs, so these report axis SIZES and rank 0 like the
+# reference does on rank 0.
+def get_data_parallel_world_size() -> int:
+    return _get_data_parallel_group().size
+
+
+def get_model_parallel_world_size() -> int:
+    return _get_model_parallel_group().size
+
+
+def get_expert_parallel_world_size(group_name: Optional[str] = None) -> int:  # noqa: ARG001
+    return _get_expert_parallel_group().size
+
+
+def get_expert_data_parallel_world_size(group_name: Optional[str] = None) -> int:  # noqa: ARG001
+    return _get_expert_data_parallel_group().size
+
+
+def get_sequence_parallel_world_size() -> int:
+    return _get_sequence_parallel_group().size
+
+
+def get_data_parallel_rank() -> int:
+    from deepspeed_tpu.comm import comm as dist
+
+    return dist.get_rank() % max(1, get_data_parallel_world_size())
+
+
+def get_model_parallel_rank() -> int:
+    return 0
+
+
+def get_expert_parallel_rank(group_name: Optional[str] = None) -> int:  # noqa: ARG001
+    return 0

@@ -1,0 +1,54 @@
+"""Inference model profiling (reference: tests/unit/inference/
+test_model_profiling.py; engine.py:167,518): per-forward latency recording,
+cleared on read."""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+import deepspeed_tpu.parallel.mesh as mesh_mod
+from deepspeed_tpu.models import TransformerLM, llama_config
+
+
+def _engine():
+    mesh_mod.reset_topology()
+    model = TransformerLM(llama_config("tiny", num_layers=2, remat=False))
+    engine = ds.init_inference(model, dtype="bf16")
+    toks = np.random.RandomState(0).randint(0, model.config.vocab_size, (2, 16)).astype(np.int32)
+    engine.init_params(toks)
+    return engine, toks
+
+
+def test_model_times_records_each_forward(eight_devices):
+    engine, toks = _engine()
+    engine.profile_model_time()
+    for _ in range(3):
+        engine(toks)
+    times = engine.model_times()
+    assert len(times) == 3
+    assert all(t > 0 for t in times)
+    assert engine.model_times() == []  # cleared on read
+
+
+def test_model_times_requires_enable(eight_devices):
+    engine, toks = _engine()
+    engine(toks)
+    with pytest.raises(AssertionError, match="not enabled"):
+        engine.model_times()
+
+
+def test_generate_is_profiled(eight_devices):
+    engine, toks = _engine()
+    engine.profile_model_time()
+    engine.generate(toks[:, :4], max_new_tokens=4)
+    engine.generate(toks[:, :4], max_new_tokens=4)
+    times = engine.model_times()
+    assert len(times) == 2 and all(t > 0 for t in times)
+
+
+def test_profiling_does_not_change_output(eight_devices):
+    engine, toks = _engine()
+    base = np.asarray(engine(toks), np.float32)
+    engine.profile_model_time()
+    prof = np.asarray(engine(toks), np.float32)
+    np.testing.assert_array_equal(base, prof)

@@ -1,0 +1,118 @@
+"""Groups accessor API (reference: tests/unit/utils/test_groups.py;
+deepspeed/utils/groups.py:51-528): mesh-axis views carrying the comm
+facade's group duck-type."""
+
+import pytest
+
+import deepspeed_tpu.parallel.mesh as mesh_mod
+from deepspeed_tpu.comm import comm as dist
+from deepspeed_tpu.parallel.mesh import MeshConfig
+from deepspeed_tpu.utils import groups
+
+
+@pytest.fixture(autouse=True)
+def _fresh_topology():
+    mesh_mod.reset_topology()
+    yield
+    mesh_mod.reset_topology()
+
+
+def test_initialize_builds_expert_axis(eight_devices):
+    groups.initialize(ep_size=4)
+    assert groups.get_expert_parallel_world_size() == 4
+    # EP is carved INSIDE data parallelism: dense-param DP stays full
+    assert groups.get_data_parallel_world_size() == 8
+    assert groups.get_expert_data_parallel_world_size() == 2
+    assert groups._get_max_expert_size_name() == "ep_size_4"
+    # the groups surface agrees with the Topology accessors
+    topo = mesh_mod.get_topology()
+    assert groups.get_data_parallel_world_size() == topo.get_data_parallel_world_size()
+    assert (
+        groups.get_expert_data_parallel_world_size()
+        == topo.get_expert_data_parallel_world_size()
+    )
+
+
+def test_initialize_preserves_other_axes(eight_devices):
+    mesh_mod.initialize_topology(MeshConfig(model=2, data=4))
+    groups.initialize(ep_size=2)
+    topo = mesh_mod.get_topology()
+    assert topo.axis_size("model") == 2  # TP survives
+    assert topo.axis_size("expert") == 2
+    assert topo.axis_size("data") == 2
+
+
+def test_initialize_is_idempotent_and_validates(eight_devices):
+    groups.initialize(ep_size=2)
+    groups.initialize(ep_size=2)  # same size: fine
+    with pytest.raises(ValueError, match="already sized"):
+        groups.initialize(ep_size=4)
+
+
+def test_indivisible_ep_size_raises(eight_devices):
+    with pytest.raises(ValueError, match="does not divide"):
+        groups.initialize(ep_size=3)
+
+
+def test_group_handles_carry_comm_ducktype(eight_devices):
+    mesh_mod.initialize_topology(MeshConfig(data=2, model=2, sequence=2))
+    dp = groups._get_data_parallel_group()
+    assert dp.size == 2 and dp.ranks == [0, 1] and len(dp) == 2
+    assert groups._get_model_parallel_group().size == 2
+    assert groups._get_sequence_parallel_group().size == 2
+    assert groups._get_sequence_data_parallel_group().size == 4
+    # the comm facade probes .size on group objects
+    assert dist.get_world_size(group=dp) == 2
+
+
+def test_expert_data_group_is_the_replication_set(eight_devices):
+    mesh_mod.initialize_topology(MeshConfig(data=4, expert=2))
+    # experts shard over 'expert' and replicate over the inner data axis
+    assert groups._get_expert_parallel_group().size == 2
+    assert groups._get_expert_data_parallel_group().size == 4
+    assert groups.get_data_parallel_world_size() == 8  # data x expert
+
+
+def test_engine_adopts_groups_topology(eight_devices):
+    """A mesh established by groups.initialize must survive engine
+    construction when the engine config names no mesh (the reference adopts
+    pre-created process groups the same way)."""
+    import deepspeed_tpu as ds
+    from tests.unit.simple_model import SimpleModel
+
+    groups.initialize(ep_size=4)
+    engine, *_ = ds.initialize(
+        model=SimpleModel(),
+        config={
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+            "bf16": {"enabled": True},
+        },
+    )
+    assert engine.topology.axis_size("expert") == 4
+    assert engine.topology.axis_size("data") == 2
+
+
+def test_engine_config_mesh_overrides_groups(eight_devices):
+    """An explicit mesh in the engine config wins over a live topology."""
+    import deepspeed_tpu as ds
+    from tests.unit.simple_model import SimpleModel
+
+    groups.initialize(ep_size=4)
+    engine, *_ = ds.initialize(
+        model=SimpleModel(),
+        config={
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+            "bf16": {"enabled": True},
+            "mesh": {"data": 8},
+        },
+    )
+    assert engine.topology.axis_size("expert") == 1
+    assert engine.topology.axis_size("data") == 8
+
+
+def test_ranks_are_rank0_views(eight_devices):
+    assert groups.get_model_parallel_rank() == 0
+    assert groups.get_expert_parallel_rank() == 0
+    assert groups.get_data_parallel_rank() == 0
