@@ -147,6 +147,7 @@ class DeepSpeedEngine:
                 )
         self._apply_mics_mesh()
         self._validate_zeropp_config()
+        self._grad_accum_dtype()  # validate combos up front, every path
         # a GROUPS-established topology (utils.groups.initialize before
         # deepspeed.initialize — the reference's pre-created process groups)
         # wins when this config doesn't ask for a specific mesh. Leftover
@@ -495,13 +496,17 @@ class DeepSpeedEngine:
         self._scale_state = jax.device_put(self.loss_scaler.init_state())
         self._build_jitted_fns()
         if not self._fused_step_enabled:
-            # fp32 accumulation buffer only exists when micro-steps accumulate
-            # across calls; the fused path keeps grads inside one program
-            zeros32 = jax.jit(
-                lambda t: jax.tree_util.tree_map(lambda x: jnp.zeros(x.shape, jnp.float32), t),
+            # accumulation buffer only exists when micro-steps accumulate
+            # across calls; the fused path keeps grads inside one program.
+            # dtype follows data_types.grad_accum_dtype (reference
+            # engine.py get_data_types; fp32 default — bf16 halves the
+            # buffer for gas>1 at reduced accumulation precision)
+            acc_dtype = self._grad_accum_dtype()
+            zeros_acc = jax.jit(
+                lambda t: jax.tree_util.tree_map(lambda x: jnp.zeros(x.shape, acc_dtype), t),
                 out_shardings=grad_shardings,
             )
-            self._grad_acc = zeros32(self._params)
+            self._grad_acc = zeros_acc(self._params)
         self._initialized = True
         n_params = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(self._params))
         log_dist(f"Initialized model state: {n_params:,} parameters", ranks=[0])
@@ -545,6 +550,41 @@ class DeepSpeedEngine:
         return jax.tree_util.tree_map(
             place, batch, shardings, is_leaf=lambda x: isinstance(x, np.ndarray)
         )
+
+    def _grad_accum_dtype(self):
+        """Accumulation dtype from data_types.grad_accum_dtype (reference
+        config: None→fp32 default)."""
+        name = self._config.data_types_config.grad_accum_dtype
+        if name is None:
+            return jnp.float32
+        table = {"fp32": jnp.float32, "float32": jnp.float32,
+                 "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+                 "fp16": jnp.float16, "float16": jnp.float16}
+        if str(name) not in table:
+            raise ValueError(
+                f"data_types.grad_accum_dtype={name!r} is not one of "
+                "fp32/bf16/fp16"
+            )
+        dtype = table[str(name)]
+        if dtype == jnp.float16 and not self._config.fp16_enabled:
+            # overflow detection is gated on the fp16 flag: an fp16 buffer
+            # without it would feed silent infs into the optimizer
+            raise ValueError(
+                "grad_accum_dtype=fp16 requires fp16.enabled (overflow "
+                "detection covers fp16 accumulation only on the fp16 path)"
+            )
+        zcfg = self._config.zero_config
+        if dtype != jnp.float32 and (
+            zcfg.zero_quantized_gradients
+            or self._offload_requested(zcfg.offload_optimizer)
+            or self._offload_requested(zcfg.offload_param)
+        ):
+            raise NotImplementedError(
+                "non-fp32 grad_accum_dtype is unsupported with quantized "
+                "gradients (qgZ) or offloaded optimizer/param state (those "
+                "paths assume fp32 accumulation buffers)"
+            )
+        return dtype
 
     def _model_kwargs(self):
         """Per-step traced model kwargs (reference engine.py:1772-1785 kwarg
@@ -606,8 +646,9 @@ class DeepSpeedEngine:
                 return loss_of(p, batch, rng, model_kwargs) * scale.astype(jnp.float32)
 
             loss_scaled, grads = jax.value_and_grad(scaled_loss)(params)
+            # accumulate in the buffer's dtype (grad_accum_dtype; fp32 default)
             new_acc = jax.tree_util.tree_map(
-                lambda a, g, s: jax.lax.with_sharding_constraint(a + g.astype(jnp.float32), NamedSharding(mesh, s)),
+                lambda a, g, s: jax.lax.with_sharding_constraint(a + g.astype(a.dtype), NamedSharding(mesh, s)),
                 grad_acc,
                 grads,
                 grad_specs,
@@ -687,7 +728,10 @@ class DeepSpeedEngine:
         def step_fn(params_or_none, master, opt_state, grad_acc, scale_state, lr):
             params = master if params_or_none is None else params_or_none
             inv = 1.0 / (scale_state.scale * gas)
-            grads = jax.tree_util.tree_map(lambda g: g * inv, grad_acc)
+            # the update math runs fp32 whatever the accumulation dtype was
+            grads = jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32) * inv, grad_acc
+            )
             new_params, new_master, new_opt, new_scale_state, grad_norm, overflow = (
                 update_from_grads(grads, params, master, opt_state, scale_state, lr)
             )
@@ -1555,7 +1599,10 @@ class DeepSpeedEngine:
         if self._param_stream is not None:
             return self._param_stream.debug_grads()
         if not self._fused_step_enabled:
-            return self._grad_acc
+            # contract: fp32 grads whatever grad_accum_dtype stores
+            return jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32), self._grad_acc
+            )
         if self._last_batch is None:
             return None
         if self._jit_debug_grad is None:
