@@ -480,7 +480,7 @@ class TransformerLM(DSModule):
         table = params["embed"]["tokens"].astype(self.dtype)
         return sparse_embedding_lookup(table, tokens, data_axes)
 
-    def _forward(self, params, tokens, rngs, train, pld_theta=None):
+    def _forward(self, params, tokens, rngs, train, pld_theta=None, ltd_idx=None):
         cfg = self.config
         tokens = jnp.asarray(tokens)
         B, T = tokens.shape
@@ -504,11 +504,23 @@ class TransformerLM(DSModule):
         base_rng = (rngs or {}).get("dropout") if isinstance(rngs, dict) else rngs
         L = cfg.num_layers
         pld_active = pld_theta is not None and train
+        ltd_active = ltd_idx is not None and train
         if pld_active and base_rng is None:
             raise ValueError(
                 "progressive layer drop needs a dropout rng (the per-layer "
                 "keep draw); pass rngs={'dropout': key} to apply()"
             )
+        if pld_active and ltd_active:
+            raise ValueError(
+                "progressive_layer_drop and random-LTD cannot be combined"
+            )
+        if ltd_active:
+            n_ltd = int(ltd_idx.shape[0])
+            if n_ltd > L - 2:
+                raise ValueError(
+                    f"random-LTD covers {n_ltd} layers but only {L - 2} middle "
+                    "layers exist (the first and last layers always run full)"
+                )
 
         def body(carry, scanned):
             x, rng = carry
@@ -540,12 +552,64 @@ class TransformerLM(DSModule):
                 x_new, aux = run(x)
             return (x_new, rng), aux
 
+        def ltd_body(carry, scanned):
+            # random-LTD (reference data_routing/basic_layer.py
+            # RandomLayerTokenDrop; kernels csrc/random_ltd/): this layer
+            # processes ONLY its own random token subset — untouched tokens
+            # ride the residual stream past it. The subset is sorted, so
+            # causal attention and RoPE see true positions in order.
+            from deepspeed_tpu.runtime.data_pipeline.data_routing import (
+                gather_tokens,
+                scatter_tokens,
+            )
+
+            x, rng = carry
+            per_layer, idx = scanned  # idx [B, kept]
+            if rng is not None:
+                rng, sub = jax.random.split(rng)
+            else:
+                sub = None
+            x_sub = gather_tokens(x, idx)
+            pos_sub = jnp.take_along_axis(positions, idx, axis=1)
+            y, aux = self._layer(x_sub, per_layer, pos_sub, sub, train)
+            x_new = self._activation_constraint(scatter_tokens(x, y, idx))
+            return (x_new, rng), aux
+
         if cfg.remat:
             policy = getattr(jax.checkpoint_policies, cfg.remat_policy, None)
             body = jax.checkpoint(body, policy=policy, prevent_cse=False)
+            ltd_body = jax.checkpoint(ltd_body, policy=policy, prevent_cse=False)
 
         aux_total = jnp.zeros((), jnp.float32)
-        if cfg.scan_layers:
+        if ltd_active:
+            # layer 0 full → LTD layers 1..1+n_ltd on subsets → rest full
+            def run_full(x, rng, aux_total, lo, hi):
+                if hi <= lo:
+                    return x, rng, aux_total
+                if cfg.scan_layers:
+                    sub = jax.tree_util.tree_map(lambda a: a[lo:hi], params["layers"])
+                    (x, rng), aux = jax.lax.scan(body, (x, rng), sub)
+                    return x, rng, aux_total + jnp.sum(aux)
+                for i in range(lo, hi):
+                    (x, rng), aux = body((x, rng), self._layer_params(params, i))
+                    aux_total = aux_total + aux
+                return x, rng, aux_total
+
+            x, base_rng, aux_total = run_full(x, base_rng, aux_total, 0, 1)
+            if cfg.scan_layers:
+                mid = jax.tree_util.tree_map(
+                    lambda a: a[1 : 1 + n_ltd], params["layers"]
+                )
+                (x, base_rng), aux = jax.lax.scan(ltd_body, (x, base_rng), (mid, ltd_idx))
+                aux_total = aux_total + jnp.sum(aux)
+            else:
+                for j in range(n_ltd):
+                    (x, base_rng), aux = ltd_body(
+                        (x, base_rng), (self._layer_params(params, 1 + j), ltd_idx[j])
+                    )
+                    aux_total = aux_total + aux
+            x, base_rng, aux_total = run_full(x, base_rng, aux_total, 1 + n_ltd, L)
+        elif cfg.scan_layers:
             xs = (
                 (params["layers"], jnp.arange(L, dtype=jnp.int32))
                 if pld_active
@@ -630,9 +694,11 @@ class TransformerLM(DSModule):
 
         return embed_fwd, layer_fwd, head_loss
 
-    def apply(self, params, batch, *, rngs=None, train: bool = True, pld_theta=None):
+    def apply(self, params, batch, *, rngs=None, train: bool = True, pld_theta=None, ltd_idx=None):
         tokens, labels = _split_batch(batch)
-        logits, aux = self._forward(params, tokens, rngs, train, pld_theta=pld_theta)
+        logits, aux = self._forward(
+            params, tokens, rngs, train, pld_theta=pld_theta, ltd_idx=ltd_idx
+        )
         if labels is None:
             return logits
         loss = cross_entropy_loss(logits, labels)

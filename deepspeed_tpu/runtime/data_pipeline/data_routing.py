@@ -14,6 +14,7 @@ values bucket compilation, like the reference's seqlen schedule).
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Dict, Tuple
 
 import jax
@@ -34,6 +35,10 @@ class RandomLTDScheduler:
 
     def update(self, global_step: int) -> int:
         frac = min(1.0, global_step / self.total)
+        if frac >= 1.0:
+            # snap to full length even when max is not a step_size multiple
+            self.current = self.max
+            return self.current
         n = self.start + (self.max - self.start) * frac
         n = int(n // self.step_size) * self.step_size
         self.current = max(self.start, min(self.max, n))
@@ -46,13 +51,21 @@ class RandomLTDScheduler:
         self.current = sd["current"]
 
 
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
+def sample_layer_token_indices(rng, n_layers: int, batch: int, seq_len: int, kept: int) -> jnp.ndarray:
+    """[n_layers, B, kept] sorted random token indices — each LTD layer
+    draws its OWN subset (the 'layerwise' in random-LTD; sorted so position
+    order — and causality — is preserved, the reference's token_sort.cu).
+    One fused program: a per-layer host loop would cost n_layers dispatch
+    round-trips per step on a tunneled backend."""
+    scores = jax.random.uniform(rng, (n_layers, batch, seq_len))
+    _, idx = jax.lax.top_k(-scores, kept)
+    return jnp.sort(idx, axis=-1).astype(jnp.int32)
+
+
 def random_token_select(rng, seq_len: int, kept: int, batch: int) -> jnp.ndarray:
-    """[B, kept] sorted random token indices (the reference's token_sort.cu:
-    sampled indices are re-sorted so position order — and causality — is
-    preserved)."""
-    scores = jax.random.uniform(rng, (batch, seq_len))
-    _, idx = jax.lax.top_k(-scores, kept)  # random subset
-    return jnp.sort(idx, axis=1)
+    """[B, kept] single-layer form of ``sample_layer_token_indices``."""
+    return sample_layer_token_indices(rng, 1, batch, seq_len, kept)[0]
 
 
 def gather_tokens(x: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:

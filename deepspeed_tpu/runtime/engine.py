@@ -233,6 +233,42 @@ class DeepSpeedEngine:
                 gamma=self._config.pld_config.gamma,
             )
 
+        # random-LTD (reference engine hooks engine.py:340-344 +
+        # data_routing scheduler; here: per-layer token-subset indices as a
+        # shape-carrying model kwarg — a kept-count change retraces exactly
+        # like the curriculum seqlen schedule) ----------------------------
+        self.random_ltd_scheduler = None
+        self._ltd_layer_num = 0
+        de_cfg = self._config.data_efficiency_config or {}
+        routing = de_cfg.get("data_routing", {})
+        ltd_cfg = routing.get("random_ltd", {})
+        if de_cfg.get("enabled") and routing.get("enabled") and ltd_cfg.get("enabled"):
+            from deepspeed_tpu.runtime.data_pipeline.data_routing import RandomLTDScheduler
+
+            sched = ltd_cfg.get("random_ltd_schedule", {})
+            if "min_value" not in sched or "max_value" not in sched:
+                raise ValueError(
+                    "random_ltd.random_ltd_schedule needs min_value and "
+                    "max_value (kept-token counts)"
+                )
+            scfg = sched.get("schedule_config", {})
+            self.random_ltd_scheduler = RandomLTDScheduler(
+                start_token_num=int(sched["min_value"]),
+                max_token_num=int(sched["max_value"]),
+                total_steps=int(scfg.get("require_steps", 1000)),
+                step_size=int(scfg.get("seq_per_step", 16)),
+            )
+            self._ltd_layer_num = int(ltd_cfg.get("random_ltd_layer_num", 1))
+            if self._ltd_layer_num < 1:
+                raise ValueError(
+                    f"random_ltd_layer_num={self._ltd_layer_num} must be >= 1 "
+                    "(0 would silently disable the feature)"
+                )
+            if self._config.pld_config.enabled:
+                raise ValueError(
+                    "progressive_layer_drop and random_ltd cannot be combined"
+                )
+
         # flops profiler (reference engine.py:574-598 wiring) -------------
         self.flops_profiler = None
         self._last_profile_args = None
@@ -274,6 +310,7 @@ class DeepSpeedEngine:
         self._profile_fn = None
         self._last_batch = None
         self._last_fwd_rng = None
+        self._last_model_kwargs = None
         self._last_fwd_scale = None
         self._jit_debug_grad = None
         self._jit_fwd_bwd = None
@@ -586,13 +623,28 @@ class DeepSpeedEngine:
             )
         return dtype
 
-    def _model_kwargs(self):
+    def _model_kwargs(self, placed=None):
         """Per-step traced model kwargs (reference engine.py:1772-1785 kwarg
-        injection). The dict STRUCTURE is static across steps — only the
-        scalar values change — so the jitted programs never retrace."""
-        if self.progressive_layer_drop is None:
-            return {}
-        return {"pld_theta": jnp.float32(self.progressive_layer_drop.get_theta())}
+        injection). PLD theta is a scalar whose VALUE changes (no retrace);
+        random-LTD indices are arrays whose SHAPE changes with the schedule
+        (retrace per kept-count bucket, like the curriculum seqlen)."""
+        kwargs = {}
+        if self.progressive_layer_drop is not None:
+            kwargs["pld_theta"] = jnp.float32(self.progressive_layer_drop.get_theta())
+        if self.random_ltd_scheduler is not None and placed is not None:
+            from deepspeed_tpu.runtime.data_pipeline.data_routing import (
+                sample_layer_token_indices,
+            )
+
+            tokens = jax.tree_util.tree_leaves(placed)[0]
+            B, T = int(tokens.shape[0]), int(tokens.shape[1])
+            kept = min(self.random_ltd_scheduler.current, T)
+            if kept < T:
+                self._rng, sub = jax.random.split(self._rng)
+                kwargs["ltd_idx"] = sample_layer_token_indices(
+                    sub, self._ltd_layer_num, B, T, kept
+                )
+        return kwargs
 
     # ------------------------------------------------------------------
     # jitted programs
@@ -903,8 +955,11 @@ class DeepSpeedEngine:
                     "training forward must be followed by backward()+step()"
                 )
             lr = self.optimizer.param_groups[0]["lr"]
+            # kwargs FIRST (may split self._rng for LTD index sampling), so
+            # parent_rng is exactly the rng the fused step receives — the
+            # debug-grad recompute derives its dropout key from it
+            model_kwargs = self._model_kwargs(placed)
             parent_rng = self._rng
-            model_kwargs = self._model_kwargs()
             if self.mixed_precision:
                 fwd_args = (
                     self._params, self._master, self._opt_state,
@@ -938,13 +993,16 @@ class DeepSpeedEngine:
             # consumed even after a dynamic-loss-scale update
             self._last_batch = batch
             self._last_fwd_rng = parent_rng
+            # the exact kwargs the step consumed (LTD indices included) — the
+            # debug-grad surface must NOT resample them
+            self._last_model_kwargs = model_kwargs
             self._last_fwd_scale = fwd_args[3 if self.mixed_precision else 2].scale
             self._last_loss = loss
             self._in_forward = True
         elif self._training_mode:
             fwd_args = (
                 self._params, self._grad_acc, self._scale_state.scale, step_rng, placed,
-                self._model_kwargs(),
+                self._model_kwargs(placed),
             )
             if profiling:
                 # abstract shapes only: grad_acc is donated by the call below
@@ -982,10 +1040,11 @@ class DeepSpeedEngine:
         (unscaled) loss; the streamer stashes activations for backward()."""
         from deepspeed_tpu.models.transformer import _split_batch
 
-        if self.progressive_layer_drop is not None:
+        if self.progressive_layer_drop is not None or self.random_ltd_scheduler is not None:
             raise NotImplementedError(
-                "progressive_layer_drop is unsupported on the param-offload "
-                "path (the layer streamer replays a fixed layer sequence)"
+                "progressive_layer_drop / random_ltd are unsupported on the "
+                "param-offload path (the layer streamer replays a fixed "
+                "layer sequence)"
             )
         tokens, labels = _split_batch(placed)
         if not self._training_mode:
@@ -1226,6 +1285,8 @@ class DeepSpeedEngine:
             self.lr_scheduler.step()
         if self.progressive_layer_drop is not None:
             self.progressive_layer_drop.update_state(self.global_steps)
+        if self.random_ltd_scheduler is not None:
+            self.random_ltd_scheduler.update(self.global_steps)
         self._overflow = False
         if self.monitor is not None and self.global_steps % self._config.steps_per_print == 0:
             self._write_monitor()
@@ -1374,6 +1435,9 @@ class DeepSpeedEngine:
             "optimizer": optimizer_state,
             "loss_scaler": _namedtuple_to_dict(self._scale_state),
             "lr_scheduler": self.lr_scheduler.state_dict() if self.lr_scheduler is not None else None,
+            "random_ltd": self.random_ltd_scheduler.state_dict()
+            if self.random_ltd_scheduler is not None
+            else None,
             "global_steps": self.global_steps,
             "global_samples": self.global_samples,
             "micro_steps": self.micro_steps,
@@ -1511,6 +1575,8 @@ class DeepSpeedEngine:
             )
         if load_lr_scheduler_states and self.lr_scheduler is not None and state.get("lr_scheduler"):
             self.lr_scheduler.load_state_dict(state["lr_scheduler"])
+        if self.random_ltd_scheduler is not None and state.get("random_ltd"):
+            self.random_ltd_scheduler.load_state_dict(state["random_ltd"])
         if not load_module_only:
             self.global_steps = state.get("global_steps", 0)
             self.global_samples = state.get("global_samples", 0)
@@ -1617,9 +1683,12 @@ class DeepSpeedEngine:
 
             self._jit_debug_grad = jax.jit(dbg)
         _, sub = jax.random.split(self._last_fwd_rng)
+        placed = self._place_batch(self._last_batch)
+        kwargs = getattr(self, "_last_model_kwargs", None)
+        if kwargs is None:
+            kwargs = self._model_kwargs(placed)
         return self._jit_debug_grad(
-            self._params, sub, self._last_fwd_scale, self._place_batch(self._last_batch),
-            self._model_kwargs(),
+            self._params, sub, self._last_fwd_scale, placed, kwargs
         )
 
     def set_params(self, tree) -> None:
