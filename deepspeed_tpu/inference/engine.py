@@ -13,6 +13,8 @@ from __future__ import annotations
 
 from typing import Any, Callable, Optional
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -316,6 +318,8 @@ class InferenceEngine:
 
     # --- generation -----------------------------------------------------
     def generate(self, *args, **kwargs):
+        """Latency-recording wrapper over ``_generate_impl`` (whose
+        signature this function adopts via functools.wraps below)."""
         if not self.model_profile_enabled:
             return self._generate_impl(*args, **kwargs)
         import time as _time
@@ -419,6 +423,10 @@ class InferenceEngine:
             top_k=top_k,
             top_p=top_p,
         )
+
+    # the public generate adopts _generate_impl's signature/doc — one
+    # source of truth for the sampling controls
+    generate = functools.wraps(_generate_impl)(generate)
 
     def _zero_generate(self, input_ids, max_new_tokens, eos_token_id, pad_token_id,
                        temperature=0.0, top_k=0, top_p=1.0):

@@ -1,0 +1,124 @@
+"""MoE parameter utilities.
+
+Counterpart of ``deepspeed/moe/utils.py`` (``is_moe_param`` :23,
+``split_params_into_shared_and_expert_params`` :29,
+``split_params_grads_into_shared_and_expert_params`` :40,
+``split_params_into_different_moe_groups_for_optimizer`` :65,
+``has_moe_layers`` :11).
+
+TPU-native design: the reference tags ``nn.Parameter`` objects with an
+``allreduce=False`` attribute at construction; here expert-ness is a
+property of a leaf's PATH in the param pytree — expert weights live under an
+``experts`` subtree (``moe/layer.py`` init; the gate stays replicated) — so
+classification is a
+pure function of the tree, usable on params AND on grad trees (which share
+the structure). Splitting returns same-structure trees with ``None`` holes,
+ready for tree_map-based norm/clip math (the reference's use case: separate
+grad-norms for expert vs shared params)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple, Union
+
+import jax
+
+# only the weights living under an "experts" subtree shard over the expert
+# axis; the gate / PR-MoE residual mlp / coefficient under "moe" are
+# REPLICATED (moe/layer.py partition rules) and must stay in the shared set
+_EXPERT_PATH_MARKERS = ("experts",)
+
+
+def _path_names(path) -> List[str]:
+    out = []
+    for entry in path:
+        key = getattr(entry, "key", None)
+        if key is None:
+            key = getattr(entry, "idx", None)
+        if key is None:
+            key = getattr(entry, "name", None)  # GetAttrKey pytree nodes
+        out.append(str(key))
+    return out
+
+
+def is_moe_param_path(path_names: Union[str, List[str]]) -> bool:
+    """True when a tree path addresses an expert weight (reference
+    ``is_moe_param`` — the ``allreduce=False`` tag, re-expressed as a path
+    property). The gate and other replicated MoE-layer params are NOT
+    expert params."""
+    if isinstance(path_names, str):
+        path_names = path_names.split("/")
+    if not all(isinstance(n, str) for n in path_names):
+        raise TypeError(
+            "is_moe_param_path takes a 'a/b/c' string or a list of path "
+            "names — in this functional design expert-ness is a property "
+            "of a leaf's tree path, not of the array"
+        )
+    return any(
+        name in _EXPERT_PATH_MARKERS or name.startswith("expert_")
+        for name in path_names
+    )
+
+
+# reference-shaped alias: there is no tensor tag to read here, so the path
+# form IS the API (arrays are rejected with a clear TypeError above)
+is_moe_param = is_moe_param_path
+
+
+def has_moe_layers(model_or_params: Any) -> Tuple[bool, int]:
+    """(has_moe, num_experts) — accepts a model family instance or a param
+    tree (reference :11 walks modules looking for MoE layers; an MoE layer
+    with one expert is still an MoE layer). The tree form reports
+    num_experts=0 (unknown from structure alone)."""
+    cfg = getattr(model_or_params, "config", None)
+    if cfg is not None and hasattr(cfg, "num_experts"):
+        return True, int(getattr(cfg, "num_experts", 0))
+    tree = model_or_params
+    if hasattr(model_or_params, "get_params"):
+        tree = model_or_params.get_params()
+    if not isinstance(tree, dict):
+        return False, 0
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return any(is_moe_param_path(_path_names(p)) for p, _ in flat), 0
+
+
+def split_params_into_shared_and_expert_params(params: Dict[str, Any]) -> Tuple[Dict, Dict]:
+    """Two same-structure trees with ``None`` holes: (shared, expert)
+    (reference :29). Works on grad trees too — structure is shared."""
+
+    def pick(want_expert):
+        def visit(path, leaf):
+            return leaf if is_moe_param_path(_path_names(path)) == want_expert else None
+
+        return jax.tree_util.tree_map_with_path(visit, params)
+
+    return pick(False), pick(True)
+
+
+# the grads variant is the same split — grad trees mirror the param tree
+split_params_grads_into_shared_and_expert_params = split_params_into_shared_and_expert_params
+
+
+def split_params_into_different_moe_groups_for_optimizer(
+    param_groups: Union[Dict, List[Dict], Tuple[Dict, ...]],
+) -> List[Dict]:
+    """Split optimizer param groups so expert subtrees sit in their own
+    groups flagged ``moe=True`` (reference :65 — ZeRO/optimizers treat
+    expert groups with expert-data-parallel reduction). Each group's
+    ``params`` is a pytree; expert leaves move to a parallel group named
+    ``<name>_moe`` with the same hyperparameters."""
+    if isinstance(param_groups, dict):
+        param_groups = [param_groups]
+    else:
+        param_groups = list(param_groups)
+    out: List[Dict] = []
+    for group in param_groups:
+        if "params" not in group:
+            raise ValueError("param group is missing a 'params' entry")
+        shared, expert = split_params_into_shared_and_expert_params(group["params"])
+        base = {k: v for k, v in group.items() if k != "params"}
+        shared_group = dict(base, params=shared, moe=False)
+        out.append(shared_group)
+        if any(leaf is not None for leaf in jax.tree_util.tree_leaves(expert)):
+            name = group.get("name", "group")
+            out.append(dict(base, params=expert, moe=True, name=f"{name}_moe"))
+    return out

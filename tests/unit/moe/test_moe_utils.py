@@ -1,0 +1,97 @@
+"""MoE param utilities (reference: deepspeed/moe/utils.py — here expert-ness
+is a path property of the param pytree, not a tensor tag)."""
+
+import jax
+import numpy as np
+import pytest
+
+from deepspeed_tpu.moe.utils import (
+    has_moe_layers,
+    is_moe_param_path,
+    split_params_into_different_moe_groups_for_optimizer,
+    split_params_into_shared_and_expert_params,
+)
+
+
+def _tree():
+    return {
+        "embed": {"tokens": np.zeros((8, 4))},
+        "layers": {
+            "wq": np.zeros((2, 4, 4)),
+            "moe": {
+                "gate": np.zeros((2, 4, 2)),
+                "experts": {"w1": np.zeros((2, 2, 4, 8))},
+            },
+        },
+    }
+
+
+def test_is_moe_param_path():
+    assert is_moe_param_path("layers/moe/experts/w1")
+    assert is_moe_param_path("expert_3/w")
+    # the gate lives under "moe" but is REPLICATED — not an expert param
+    assert not is_moe_param_path(["layers", "moe", "gate"])
+    assert not is_moe_param_path("layers/wq")
+    assert not is_moe_param_path(["embed", "tokens"])
+
+
+def test_array_argument_rejected_clearly():
+    with pytest.raises(TypeError, match="tree path"):
+        is_moe_param_path([np.zeros((2, 2))])
+
+
+def test_split_shared_and_expert():
+    tree = _tree()
+    shared, expert = split_params_into_shared_and_expert_params(tree)
+    # shared: embed.tokens, layers.wq, AND the replicated gate
+    assert len(jax.tree_util.tree_leaves(shared)) == 3
+    assert len(jax.tree_util.tree_leaves(expert)) == 1  # experts.w1 only
+    # leaves keep identity — no copies
+    assert shared["embed"]["tokens"] is tree["embed"]["tokens"]
+    assert shared["layers"]["moe"]["gate"] is tree["layers"]["moe"]["gate"]
+    assert expert["layers"]["moe"]["experts"]["w1"] is tree["layers"]["moe"]["experts"]["w1"]
+    # non-expert positions are None holes in the expert tree
+    assert expert["layers"]["wq"] is None
+    assert expert["layers"]["moe"]["gate"] is None
+
+
+def test_has_moe_layers_from_tree_and_model():
+    assert has_moe_layers(_tree())[0]
+    assert not has_moe_layers({"layers": {"wq": np.zeros((2, 2))}})[0]
+
+    from deepspeed_tpu.models import MoETransformerLM, TransformerLM, llama_config, moe_llama_config
+
+    moe_model = MoETransformerLM(moe_llama_config("tiny", num_layers=2, num_experts=2, max_seq_len=32))
+    dense = TransformerLM(llama_config("tiny", num_layers=2))
+    has, n = has_moe_layers(moe_model)
+    assert has and n == 2
+    assert not has_moe_layers(dense)[0]
+    # one-expert MoE is still an MoE family
+    one = MoETransformerLM(moe_llama_config("tiny", num_layers=2, num_experts=1, max_seq_len=32))
+    assert has_moe_layers(one) == (True, 1)
+
+
+def test_optimizer_group_split():
+    groups = split_params_into_different_moe_groups_for_optimizer(
+        {"name": "g0", "params": _tree(), "lr": 1e-3}
+    )
+    assert len(groups) == 2
+    shared_g, moe_g = groups
+    assert shared_g["moe"] is False and moe_g["moe"] is True
+    assert moe_g["name"] == "g0_moe"
+    assert moe_g["lr"] == 1e-3  # hyperparameters copied
+    assert len(jax.tree_util.tree_leaves(moe_g["params"])) == 1
+    assert len(jax.tree_util.tree_leaves(shared_g["params"])) == 3
+
+
+def test_optimizer_group_split_no_experts_passthrough():
+    groups = split_params_into_different_moe_groups_for_optimizer(
+        [{"params": {"w": np.zeros((2, 2))}, "lr": 1.0}]
+    )
+    assert len(groups) == 1
+    assert groups[0]["moe"] is False
+
+
+def test_group_without_params_raises():
+    with pytest.raises(ValueError, match="params"):
+        split_params_into_different_moe_groups_for_optimizer({"lr": 1.0})
