@@ -1,0 +1,68 @@
+"""TP token mappings for MoE (reference: ``deepspeed/moe/mappings.py`` —
+``gather_tokens``/``drop_tokens`` all-gather or shard activations along a
+dim over the tensor-parallel group, with hand-written autograd duals).
+
+TPU-native design: both are sharding constraints touching ONLY the mapped
+dim — every other dim stays ``UNCONSTRAINED`` so existing data/sequence
+shardings survive (the reference likewise only moves data over the TP
+group). ``drop_tokens`` pins the dim to the ``model`` axis; ``gather_tokens``
+pins it unsharded (XLA inserts the TP all-gather). NOTE on backward:
+``with_sharding_constraint`` transposes to the SAME constraint (cotangents
+take the forward layout, not the reference's inverse reshard) — values are
+identical, only gradient layout differs, and GSPMD reshards lazily at the
+next use."""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from deepspeed_tpu.parallel import mesh as mesh_mod
+
+_U = PartitionSpec.UNCONSTRAINED
+
+
+def _live_tp():
+    """(topology, tp_size) without side effects: no topology is CREATED here
+    — before initialize_topology these are identity maps (reference returns
+    the input unchanged when mpu is None / mp_size == 1)."""
+    topo = mesh_mod._TOPOLOGY
+    if topo is None:
+        return None, 1
+    return topo, topo.axis_size("model")
+
+
+def gather_tokens(input_, dim: int = 0):
+    """Un-shard ``dim`` from the TP group (reference ``gather_tokens``):
+    the dim becomes whole on every TP shard; other dims keep their layout."""
+    topo, tp = _live_tp()
+    if tp <= 1:
+        return input_
+    spec = [_U] * input_.ndim
+    spec[dim] = None
+    return jax.lax.with_sharding_constraint(
+        input_, NamedSharding(topo.mesh, PartitionSpec(*spec))
+    )
+
+
+def drop_tokens(input_, dim: int = 0):
+    """Shard ``dim`` over the TP group (reference ``drop_tokens``): each
+    shard keeps its own chunk; other dims keep their layout."""
+    topo, tp = _live_tp()
+    if tp <= 1:
+        return input_
+    if input_.shape[dim] % tp != 0:
+        raise ValueError(
+            f"dimension {dim} ({input_.shape[dim]}) is not divisible by the "
+            f"tensor-parallel world size ({tp})"
+        )
+    spec = [_U] * input_.ndim
+    spec[dim] = "model"
+    return jax.lax.with_sharding_constraint(
+        input_, NamedSharding(topo.mesh, PartitionSpec(*spec))
+    )
+
+
+# reference private aliases
+_gather_tokens = gather_tokens
+_drop_tokens = drop_tokens
