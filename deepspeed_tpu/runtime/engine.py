@@ -269,6 +269,27 @@ class DeepSpeedEngine:
                     "progressive_layer_drop and random_ltd cannot be combined"
                 )
 
+        # MoQ: in-step progressive weight quantization (reference
+        # _configure_quantization engine.py:1330 + runtime/quantize.py;
+        # distinct from compression/'s in-forward QAT) --------------------
+        from deepspeed_tpu.runtime.quantize import moq_from_compression_config
+
+        self.quantizer = moq_from_compression_config(self._config.compression_config)
+        if self.quantizer is not None:
+            if not (self._config.fp16_enabled or self._config.bfloat16_enabled):
+                # reference: "MoQ ... is only supported for FP16" — the
+                # compute store must be separate from the fp32 master it
+                # anneals against
+                raise ValueError(
+                    "MoQ (quantize_weight_in_forward: false) requires fp16 "
+                    "or bf16 mixed precision"
+                )
+            if self._offload_requested(self._config.zero_config.offload_param):
+                raise NotImplementedError(
+                    "MoQ is unsupported with ZeRO param offload (weights "
+                    "live in the layer stream, not the HBM compute store)"
+                )
+
         # flops profiler (reference engine.py:574-598 wiring) -------------
         self.flops_profiler = None
         self._last_profile_args = None
@@ -1287,7 +1308,15 @@ class DeepSpeedEngine:
             self.progressive_layer_drop.update_state(self.global_steps)
         if self.random_ltd_scheduler is not None:
             self.random_ltd_scheduler.update(self.global_steps)
+        step_was_skipped = self._overflow
         self._overflow = False
+        if self.quantizer is not None and self._params is not None and not step_was_skipped:
+            # MoQ: re-quantize the compute-dtype store after the update; the
+            # fp32 master stays full precision (reference fp16 optimizer
+            # calls Quantizer.quantize after each step)
+            if self.quantizer.out_shardings is None:
+                self.quantizer.out_shardings = self._param_shardings
+            self._params = self.quantizer.quantize_tree(self._params, self.global_steps)
         if self.monitor is not None and self.global_steps % self._config.steps_per_print == 0:
             self._write_monitor()
 
@@ -1438,6 +1467,7 @@ class DeepSpeedEngine:
             "random_ltd": self.random_ltd_scheduler.state_dict()
             if self.random_ltd_scheduler is not None
             else None,
+            "moq": self.quantizer.state_dict() if self.quantizer is not None else None,
             "global_steps": self.global_steps,
             "global_samples": self.global_samples,
             "micro_steps": self.micro_steps,
@@ -1577,6 +1607,8 @@ class DeepSpeedEngine:
             self.lr_scheduler.load_state_dict(state["lr_scheduler"])
         if self.random_ltd_scheduler is not None and state.get("random_ltd"):
             self.random_ltd_scheduler.load_state_dict(state["random_ltd"])
+        if self.quantizer is not None and state.get("moq"):
+            self.quantizer.load_state_dict(state["moq"])
         if not load_module_only:
             self.global_steps = state.get("global_steps", 0)
             self.global_samples = state.get("global_samples", 0)

@@ -1,0 +1,204 @@
+"""MoQ — Mixture-of-Quantization training (reference:
+``deepspeed/runtime/quantize.py`` ``Quantizer``, wired by
+``engine._configure_quantization`` engine.py:1330 when the compression
+config's ``weight_quantization.shared_parameters`` enables quantization
+with ``quantize_weight_in_forward: false``).
+
+Semantics (reference ``Quantizer.quantize``/``mixed_fp16_quantize``): after
+every optimizer step the COMPUTE-dtype weights are re-quantized while the
+fp32 master stays full precision; under ``fp16_mixed_quantize`` the stored
+weight is a blend ``ratio * w + (1 - ratio) * Q(w, bits)`` whose ratio
+decays by ``quantize_change_ratio`` per step, annealing smoothly into the
+quantized representation; the bit-width steps down from ``start_bits``
+toward ``target_bits`` across ``quantize_period``-doubling windows.
+
+TPU-native design: one jitted elementwise pass over the param tree per
+step (the master→compute recast already rewrites every weight each step,
+so re-quantizing after it is exactly the reference's per-step behavior).
+The blend ratio is a traced scalar (no retrace as it decays); a bit-width
+switch retraces once per bits value. Eigenvalue-modulated per-layer timing
+(reference ``q_eigenvalue``) is not implemented — the standalone
+``runtime/eigenvalue.py`` provides the measurement half."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def quantize_symmetric(w, bits: int, groups: int = 1):
+    """Per-group symmetric fake-quantization (reference q_type=0 path).
+
+    NOT delegated to ``ops/quantizer``: that module stores int8, which
+    cannot represent the >8-bit levels MoQ anneals through (start_bits is
+    typically 16); this fake path computes levels in fp32 at any width."""
+    flat = w.reshape(-1)
+    n = flat.shape[0]
+    g = groups if groups > 0 and n % groups == 0 else 1
+    grouped = flat.reshape(g, n // g)
+    qmax = 2.0 ** (bits - 1) - 1.0
+    scale = jnp.max(jnp.abs(grouped), axis=1, keepdims=True) / qmax
+    scale = jnp.where(scale == 0, 1.0, scale)
+    q = jnp.clip(jnp.round(grouped / scale), -qmax - 1, qmax)
+    return (q * scale).reshape(w.shape).astype(w.dtype)
+
+
+def quantize_asymmetric(w, bits: int, groups: int = 1):
+    """Per-group asymmetric fake-quantization (reference q_type=1)."""
+    flat = w.reshape(-1)
+    n = flat.shape[0]
+    g = groups if groups > 0 and n % groups == 0 else 1
+    grouped = flat.reshape(g, n // g)
+    lo = jnp.min(grouped, axis=1, keepdims=True)
+    hi = jnp.max(grouped, axis=1, keepdims=True)
+    levels = 2.0**bits - 1.0
+    scale = jnp.where(hi > lo, (hi - lo) / levels, 1.0)
+    q = jnp.round((grouped - lo) / scale)
+    return (q * scale + lo).reshape(w.shape).astype(w.dtype)
+
+
+class Quantizer:
+    """Progressive in-step weight quantization (reference quantize.py:10).
+
+    ``quantize_tree(params, step)`` returns params with every 2-D+ floating
+    leaf re-quantized at the current bit-width/mix ratio."""
+
+    def __init__(
+        self,
+        q_groups: int = 1,
+        q_mixed_fp16: bool = False,
+        q_change_ratio: float = 0.001,
+        q_type: int = 0,
+        q_rounding: int = 0,  # noqa: ARG002 - nearest only (stochastic n/a)
+        q_verbose: bool = False,
+        start_bits: int = 16,
+        target_bits: int = 8,
+        quantize_period: int = 1000,
+        schedule_offset: int = 0,
+    ):
+        self.q_groups = int(q_groups)
+        self.q_mixed_fp16 = bool(q_mixed_fp16)
+        self.q_change_ratio = float(q_change_ratio)
+        self.q_type = int(q_type)
+        self.q_verbose = bool(q_verbose)
+        self.start_bits = int(start_bits)
+        self.target_bits = int(target_bits)
+        self.period = max(1, int(quantize_period))
+        self.schedule_offset = int(schedule_offset)
+        self.quantize_real_ratio = 1.0
+        self.out_shardings = None  # engine sets this to the param shardings
+        self._jit_cache: Dict[int, Any] = {}
+
+    def state_dict(self) -> Dict[str, Any]:
+        """The anneal ratio is path-dependent state (unlike bits, which are
+        a pure function of the step) — it must ride in checkpoints."""
+        return {"quantize_real_ratio": self.quantize_real_ratio}
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self.quantize_real_ratio = float(sd.get("quantize_real_ratio", 1.0))
+
+    def current_bits(self, step: int) -> int:
+        """Bit-width at ``step``: halves each period-doubling window
+        (reference precision-switch behavior) from start toward target."""
+        if step < self.schedule_offset:
+            return self.start_bits
+        bits = self.start_bits
+        window = self.period
+        s = step - self.schedule_offset
+        while bits > self.target_bits and s >= window:
+            bits = max(self.target_bits, bits // 2)
+            s -= window
+            window *= 2  # reference doubles the period per switch
+        return bits
+
+    def update_ratio(self) -> float:
+        """Anneal the fp16-mix ratio (reference ``update_fp16_ratio``)."""
+        if self.q_mixed_fp16:
+            self.quantize_real_ratio = max(0.0, self.quantize_real_ratio - self.q_change_ratio)
+        else:
+            self.quantize_real_ratio = 0.0
+        return self.quantize_real_ratio
+
+    def _build(self, bits: int):
+        qfn = quantize_symmetric if self.q_type == 0 else quantize_asymmetric
+        groups = self.q_groups
+
+        def quantize_tree(params, ratio):
+            def leaf(w):
+                if not hasattr(w, "ndim") or w.ndim < 2 or not jnp.issubdtype(w.dtype, jnp.floating):
+                    return w
+                if w.dtype == jnp.float32:
+                    # keep_fp32_params leaves stay full precision in the
+                    # mixed-precision compute tree — honor that request
+                    return w
+                qw = qfn(w, bits, groups)
+                return (ratio * w + (1.0 - ratio) * qw).astype(w.dtype)
+
+            return jax.tree_util.tree_map(leaf, params)
+
+        # preserve the params' GSPMD layout: the per-group reshape would
+        # otherwise let XLA pick a fresh output sharding and force a
+        # reshard on the next step
+        if self.out_shardings is not None:
+            return jax.jit(quantize_tree, out_shardings=self.out_shardings)
+        return jax.jit(quantize_tree)
+
+    def quantize_tree(self, params, step: int):
+        if step < self.schedule_offset:
+            return params
+        bits = self.current_bits(step)
+        fn = self._jit_cache.get(bits)
+        if fn is None:
+            fn = self._jit_cache[bits] = self._build(bits)
+        ratio = self.update_ratio()
+        return fn(params, jnp.float32(ratio))
+
+
+def moq_from_compression_config(compression_cfg: Optional[dict]) -> Optional[Quantizer]:
+    """Build a Quantizer from the reference compression-config layout
+    (``weight_quantization.shared_parameters`` with
+    ``quantize_weight_in_forward: false`` — in-forward quantization is the
+    QAT path owned by ``compression/``)."""
+    if not compression_cfg:
+        return None
+    wq = compression_cfg.get("weight_quantization", {})
+    shared = wq.get("shared_parameters", {})
+    if not shared.get("enabled", False):
+        return None
+    if shared.get("quantize_weight_in_forward", False):
+        return None  # QAT (compression/) owns the in-forward path
+    groups_cfg = wq.get("different_groups", {})
+    if len(groups_cfg) > 1 or any("modules" in g for g in groups_cfg.values()):
+        from deepspeed_tpu.utils.logging import logger
+
+        logger.warning(
+            "MoQ here applies ONE shared schedule to every weight: extra "
+            "different_groups entries and per-group 'modules' patterns are "
+            "ignored (the first group's bits/period win)"
+        )
+    start_bits, target_bits, period = 16, 8, 1000
+    for g in groups_cfg.values():
+        p = g.get("params", {})
+        start_bits = int(p.get("start_bits", start_bits))
+        target_bits = int(p.get("target_bits", target_bits))
+        period = int(p.get("quantize_period", period))
+        break  # shared schedule: the first group sets it
+    return Quantizer(
+        q_groups=int(shared.get("quantize_groups", 1)),
+        q_mixed_fp16=bool(shared.get("fp16_mixed_quantize", {}).get("enabled", False))
+        if isinstance(shared.get("fp16_mixed_quantize"), dict)
+        else bool(shared.get("fp16_mixed_quantize", False)),
+        q_change_ratio=float(
+            shared.get("fp16_mixed_quantize", {}).get("quantize_change_ratio", 0.001)
+            if isinstance(shared.get("fp16_mixed_quantize"), dict)
+            else shared.get("quantize_change_ratio", 0.001)
+        ),
+        q_type=0 if str(shared.get("quantization_type", "symmetric")) == "symmetric" else 1,
+        q_verbose=bool(shared.get("quantize_verbose", False)),
+        start_bits=start_bits,
+        target_bits=target_bits,
+        quantize_period=period,
+        schedule_offset=int(shared.get("schedule_offset", 0)),
+    )

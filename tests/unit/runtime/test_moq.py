@@ -1,0 +1,174 @@
+"""MoQ in-step weight quantization (reference: deepspeed/runtime/
+quantize.py + engine._configure_quantization engine.py:1330): compute
+weights re-quantize progressively after each step while the fp32 master
+stays full precision."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+import deepspeed_tpu.parallel.mesh as mesh_mod
+from deepspeed_tpu.runtime.quantize import (
+    Quantizer,
+    moq_from_compression_config,
+    quantize_asymmetric,
+    quantize_symmetric,
+)
+from tests.unit.simple_model import SimpleModel, random_dataloader
+
+
+class TestQuantizeMath:
+    def test_symmetric_roundtrip_levels(self):
+        w = jnp.asarray(np.linspace(-1, 1, 64, dtype=np.float32).reshape(8, 8))
+        q8 = quantize_symmetric(w, 8)
+        assert float(jnp.max(jnp.abs(q8 - w))) < 1.0 / 127  # within one level
+        q2 = quantize_symmetric(w, 2)
+        assert len(np.unique(np.asarray(q2))) <= 4  # 2-bit: at most 4 levels
+
+    def test_asymmetric_handles_offset_ranges(self):
+        w = jnp.asarray(np.linspace(3.0, 5.0, 64, dtype=np.float32).reshape(8, 8))
+        q = quantize_asymmetric(w, 8)
+        assert float(jnp.max(jnp.abs(q - w))) < (5.0 - 3.0) / 255 + 1e-6
+        # symmetric wastes half its range on the unused negative side
+        qs = quantize_symmetric(w, 4)
+        qa = quantize_asymmetric(w, 4)
+        assert float(jnp.max(jnp.abs(qa - w))) < float(jnp.max(jnp.abs(qs - w)))
+
+    def test_grouping(self):
+        rs = np.random.RandomState(0)
+        w = jnp.asarray(rs.randn(4, 16).astype(np.float32))
+        # one outlier per group: per-group scales quantize the rest finer
+        w = w.at[0, 0].set(100.0)
+        err_g1 = float(jnp.mean(jnp.abs(quantize_symmetric(w, 8, groups=1) - w)))
+        err_g4 = float(jnp.mean(jnp.abs(quantize_symmetric(w, 8, groups=4) - w)))
+        assert err_g4 < err_g1
+
+
+class TestSchedule:
+    def test_bits_halve_per_doubling_window(self):
+        q = Quantizer(start_bits=16, target_bits=4, quantize_period=10)
+        assert q.current_bits(0) == 16
+        assert q.current_bits(9) == 16
+        assert q.current_bits(10) == 8
+        assert q.current_bits(29) == 8  # next window is 20 long
+        assert q.current_bits(30) == 4
+        assert q.current_bits(10_000) == 4  # floor
+
+    def test_mixed_ratio_anneals(self):
+        q = Quantizer(q_mixed_fp16=True, q_change_ratio=0.25)
+        ratios = [q.update_ratio() for _ in range(5)]
+        assert ratios == [0.75, 0.5, 0.25, 0.0, 0.0]
+        q2 = Quantizer(q_mixed_fp16=False)
+        assert q2.update_ratio() == 0.0
+
+    def test_config_parse(self):
+        cfg = {
+            "weight_quantization": {
+                "shared_parameters": {
+                    "enabled": True,
+                    "quantize_weight_in_forward": False,
+                    "quantize_groups": 4,
+                    "quantization_type": "asymmetric",
+                    "schedule_offset": 5,
+                },
+                "different_groups": {
+                    "g0": {"params": {"start_bits": 8, "target_bits": 4, "quantize_period": 50}}
+                },
+            }
+        }
+        q = moq_from_compression_config(cfg)
+        assert q is not None
+        assert (q.q_groups, q.q_type, q.schedule_offset) == (4, 1, 5)
+        assert (q.start_bits, q.target_bits, q.period) == (8, 4, 50)
+        # in-forward (QAT) and disabled configs produce no MoQ quantizer
+        cfg["weight_quantization"]["shared_parameters"]["quantize_weight_in_forward"] = True
+        assert moq_from_compression_config(cfg) is None
+        assert moq_from_compression_config({}) is None
+
+
+class TestEngineMoQ:
+    def _cfg(self, **over):
+        base = {
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 1},
+            "compression_training": {
+                "weight_quantization": {
+                    "shared_parameters": {
+                        "enabled": True,
+                        "quantize_weight_in_forward": False,
+                        "quantize_groups": 1,
+                    },
+                    "different_groups": {
+                        "g0": {"params": {"start_bits": 8, "target_bits": 8, "quantize_period": 1}}
+                    },
+                }
+            },
+        }
+        base.update(over)
+        return base
+
+    def test_weights_quantized_master_full_precision(self, eight_devices):
+        mesh_mod.reset_topology()
+        engine, *_ = ds.initialize(model=SimpleModel(), config=self._cfg())
+        assert engine.quantizer is not None
+        batch = next(random_dataloader(total_samples=8, batch_size=8))
+        losses = []
+        for _ in range(3):
+            loss = engine(batch)
+            engine.backward(loss)
+            engine.step()
+            losses.append(float(jax.device_get(loss)))
+        assert all(np.isfinite(l) for l in losses)
+        w = np.asarray(jax.device_get(engine.get_params()["w0"]), np.float32)
+        m = np.asarray(jax.device_get(engine.get_master_params()["w0"]), np.float32)
+        # the compute store is 8-bit (few distinct levels); the master is not
+        assert len(np.unique(w)) <= 256
+        assert len(np.unique(m)) > 250
+        # quantized store really is the quantized master
+        from deepspeed_tpu.runtime.quantize import quantize_symmetric as qs
+
+        expect = np.asarray(qs(jnp.asarray(m, jnp.bfloat16), 8), np.float32)
+        np.testing.assert_allclose(w, expect, atol=2e-2)
+
+    def test_moq_requires_mixed_precision(self, eight_devices):
+        mesh_mod.reset_topology()
+        with pytest.raises(ValueError, match="mixed precision"):
+            ds.initialize(
+                model=SimpleModel(),
+                config=self._cfg(bf16={"enabled": False}),
+            )
+
+    def test_anneal_ratio_survives_resume(self, tmp_path, eight_devices):
+        mesh_mod.reset_topology()
+        cfg = self._cfg()
+        shared = cfg["compression_training"]["weight_quantization"]["shared_parameters"]
+        shared["fp16_mixed_quantize"] = {"enabled": True, "quantize_change_ratio": 0.2}
+        engine, *_ = ds.initialize(model=SimpleModel(), config=cfg)
+        batch = next(random_dataloader(total_samples=8, batch_size=8))
+        for _ in range(3):
+            loss = engine(batch); engine.backward(loss); engine.step()
+        assert engine.quantizer.quantize_real_ratio == pytest.approx(0.4)
+        engine.save_checkpoint(str(tmp_path))
+
+        mesh_mod.reset_topology()
+        engine2, *_ = ds.initialize(model=SimpleModel(), config=cfg)
+        engine2.init_params(batch)
+        assert engine2.quantizer.quantize_real_ratio == 1.0  # fresh
+        engine2.load_checkpoint(str(tmp_path))
+        assert engine2.quantizer.quantize_real_ratio == pytest.approx(0.4)
+
+    def test_training_still_learns(self, eight_devices):
+        mesh_mod.reset_topology()
+        engine, *_ = ds.initialize(model=SimpleModel(), config=self._cfg())
+        batch = next(random_dataloader(total_samples=8, batch_size=8))
+        losses = []
+        for _ in range(6):
+            loss = engine(batch)
+            engine.backward(loss)
+            engine.step()
+            losses.append(float(jax.device_get(loss)))
+        assert losses[-1] < losses[0], losses
