@@ -1438,7 +1438,7 @@ class DeepSpeedEngine:
             )
         if tag is None:
             tag = f"global_step{self.global_steps}"
-        self._validate_checkpoint_tag(tag)
+        tag = self._validate_checkpoint_tag(tag)
         path = self._ckpt_dir(save_dir, tag)
         self.checkpoint_engine.create(tag)
         if self._param_stream is not None:
@@ -1485,16 +1485,25 @@ class DeepSpeedEngine:
         dist.barrier(name="save_checkpoint")
         return True
 
-    def _validate_checkpoint_tag(self, tag: str) -> None:
-        """Cross-rank tag equality check (reference engine.py:2944)."""
+    def _validate_checkpoint_tag(self, tag: str) -> str:
+        """Cross-rank tag equality check (reference engine.py:2944).
+
+        Returns the tag to USE. On mismatch: Fail raises; Warn warns and
+        adopts rank 0's tag — checkpoints here are collective global-array
+        saves, so ranks entering different tags would deadlock the save
+        (the reference writes per-rank files and merely produces a
+        scattered checkpoint; a coherent save under one tag is the
+        TPU-native equivalent of 'proceed with a warning')."""
         if not self._config.checkpoint_tag_validation_enabled or dist.get_world_size() == 1:
-            return
+            return tag
         tags = dist.all_gather_object(tag)
         if any(t != tag for t in tags):
             msg = f"checkpoint tag mismatch across ranks: {tags}"
             if self._config.checkpoint_tag_validation_fail:
                 raise RuntimeError(msg)
-            logger.warning(msg)
+            logger.warning(msg + f" — saving under rank 0's tag {tags[0]!r}")
+            return tags[0]
+        return tag
 
     def load_checkpoint(
         self,
@@ -1534,7 +1543,7 @@ class DeepSpeedEngine:
                 self._param_stream.load_master_state(opt_state["param_stream"])
             if state.get("loss_scaler") is not None:
                 self._scale_state = jax.device_put(
-                    _dict_to_namedtuple(state["loss_scaler"], LossScaleState)
+                    _dict_to_namedtuple(_host_scalar_tree(state["loss_scaler"]), LossScaleState)
                 )
             if load_lr_scheduler_states and self.lr_scheduler is not None and state.get("lr_scheduler"):
                 self.lr_scheduler.load_state_dict(state["lr_scheduler"])
@@ -1547,7 +1556,7 @@ class DeepSpeedEngine:
                     self.progressive_layer_drop.update_state(self.global_steps)
             return path, state.get("client_state", {})
         put_p = jax.jit(lambda t: t, out_shardings=self._param_shardings)
-        self._params = put_p(jax.tree_util.tree_map(jnp.asarray, state["module"]))
+        self._params = put_p(_as_device_tree(state["module"]))
         if self._host_offload is not None:
             opt_state = state.get("optimizer")
             if isinstance(opt_state, dict) and "host_offload" in opt_state:
@@ -1557,20 +1566,16 @@ class DeepSpeedEngine:
                     # stale init-time master
                     self._host_offload.load_master_only(opt_state["host_offload"])
             elif state.get("master") is not None:
-                # checkpoint from a non-offload run: adopt its master
-                leaves = jax.tree_util.tree_leaves(
-                    jax.tree_util.tree_map(jnp.asarray, state["master"])
-                )
-                self._host_offload.set_master_leaves(leaves)
+                # checkpoint from a non-offload run: adopt its master —
+                # HOST leaves (set_master_leaves copies host-side; a device
+                # round-trip would spike HBM exactly where offload avoids it)
+                self._host_offload.set_master_leaves(_host_leaves(state["master"]))
             else:
                 # fp32 non-offload checkpoint: module weights ARE the master
-                leaves = jax.tree_util.tree_leaves(
-                    jax.tree_util.tree_map(jnp.asarray, state["module"])
-                )
-                self._host_offload.set_master_leaves(leaves)
+                self._host_offload.set_master_leaves(_host_leaves(state["module"]))
         elif self.mixed_precision and state.get("master") is not None:
             put_m = jax.jit(lambda t: t, out_shardings=self._master_shardings)
-            self._master = put_m(jax.tree_util.tree_map(jnp.asarray, state["master"]))
+            self._master = put_m(_as_device_tree(state["master"]))
         elif self.mixed_precision:
             # checkpoint carries no fp32 master (saved by an offload engine or
             # module-only): rebuild it from the loaded module weights, or the
@@ -1598,10 +1603,10 @@ class DeepSpeedEngine:
             else:
                 opt = _dict_to_namedtuple(state["optimizer"], type(self._opt_state))
                 put_o = jax.jit(lambda t: t, out_shardings=self._opt_shardings)
-                self._opt_state = put_o(jax.tree_util.tree_map(jnp.asarray, opt))
+                self._opt_state = put_o(_as_device_tree(opt))
         if state.get("loss_scaler") is not None:
             self._scale_state = jax.device_put(
-                _dict_to_namedtuple(state["loss_scaler"], LossScaleState)
+                _dict_to_namedtuple(_host_scalar_tree(state["loss_scaler"]), LossScaleState)
             )
         if load_lr_scheduler_states and self.lr_scheduler is not None and state.get("lr_scheduler"):
             self.lr_scheduler.load_state_dict(state["lr_scheduler"])
@@ -1829,6 +1834,49 @@ def _dict_to_namedtuple(d, cls):
         v = d[f]
         vals.append(v)
     return cls(*vals)
+
+
+def _host_leaves(tree):
+    """Flat HOST numpy leaves for the host-offload master adoption: numpy
+    stays put, addressable device arrays fetch, replicated multi-process
+    globals read their local shard; a cross-process-SHARDED master cannot
+    be adopted host-side (no local full copy exists) and says so."""
+    def leaf(x):
+        if isinstance(x, jax.Array):
+            if x.is_fully_addressable:
+                return np.asarray(jax.device_get(x))
+            shard = x.addressable_shards[0]
+            if shard.data.shape == x.shape:  # replicated
+                return np.asarray(shard.data)
+            raise NotImplementedError(
+                "adopting a cross-process-sharded master into the "
+                "host-offload engine is unsupported (no process holds the "
+                "full tensor); save from the offload engine instead"
+            )
+        return np.asarray(x)
+
+    return [leaf(l) for l in jax.tree_util.tree_leaves(tree)]
+
+
+def _host_scalar_tree(tree):
+    """Loss-scale state leaves are replicated scalars; a multi-process orbax
+    restore hands them back as global arrays that a local device_put
+    rejects — read the locally-addressable shard instead."""
+    def leaf(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            return np.asarray(x.addressable_shards[0].data)
+        return np.asarray(jax.device_get(x)) if isinstance(x, jax.Array) else x
+
+    return jax.tree_util.tree_map(leaf, tree)
+
+
+def _as_device_tree(tree):
+    """numpy leaves -> device arrays; jax arrays (possibly multi-process
+    GLOBAL arrays from an orbax restore) pass through untouched — a local
+    jnp.asarray on a non-addressable global array is an error."""
+    return jax.tree_util.tree_map(
+        lambda x: x if isinstance(x, jax.Array) else jnp.asarray(x), tree
+    )
 
 
 def _live_topology():

@@ -1,0 +1,120 @@
+"""Checkpoint tag validation across REAL processes (reference:
+tests/unit/checkpoint/test_tag_validation.py; engine.py:2944 all-gathers
+the tag and asserts equality, config checkpoint.tag_validation
+Warn/Fail/Ignore)."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = r"""
+import os, sys
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import deepspeed_tpu as ds
+from deepspeed_tpu import comm as dist
+from tests.unit.simple_model import SimpleModel, random_dataloader
+
+mode = os.environ["TAG_MODE"]          # Warn | Fail | Ignore
+mismatch = os.environ["TAG_MISMATCH"] == "1"
+ckpt_dir = os.environ["TAG_CKPT_DIR"]
+
+ds.init_distributed()
+rank = dist.get_rank()
+engine, *_ = ds.initialize(model=SimpleModel(), config={
+    "train_micro_batch_size_per_gpu": 8,
+    "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+    "bf16": {"enabled": True},
+    "checkpoint": {"tag_validation": mode},
+})
+batch = next(random_dataloader(total_samples=8, batch_size=8))
+loss = engine(batch); engine.backward(loss); engine.step()
+
+tag = f"tag_rank{rank}" if mismatch else "tag_same"
+try:
+    engine.save_checkpoint(ckpt_dir, tag=tag)
+    # warn mode normalizes mismatched tags to rank 0's so the collective
+    # save stays coherent — the latest file must name THAT tag
+    with open(os.path.join(ckpt_dir, "latest")) as f:
+        saved_tag = f.read().strip()
+    expect = "tag_rank0" if mismatch else "tag_same"
+    assert saved_tag == expect, (saved_tag, expect)
+    print(f"RANK{rank} SAVED", flush=True)
+except RuntimeError as e:
+    assert "mismatch" in str(e), e
+    print(f"RANK{rank} REJECTED", flush=True)
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _run(mode, mismatch, tmp_path):
+    port = _free_port()
+    procs = []
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+    for rank in range(2):
+        env = dict(os.environ)
+        env.update(
+            MASTER_ADDR="127.0.0.1",
+            MASTER_PORT=str(port),
+            RANK=str(rank),
+            WORLD_SIZE="2",
+            JAX_PLATFORMS="cpu",
+            PALLAS_AXON_POOL_IPS="",
+            XLA_FLAGS="--xla_force_host_platform_device_count=1",
+            TAG_MODE=mode,
+            TAG_MISMATCH="1" if mismatch else "0",
+            TAG_CKPT_DIR=str(tmp_path / f"ck_{mode}"),
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-c", _WORKER],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+                cwd=repo,
+            )
+        )
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        outs.append(out)
+    return procs, outs
+
+
+@pytest.mark.parametrize("mode", ["Warn", "Ignore"])
+def test_matching_tags_save(mode, tmp_path):
+    procs, outs = _run(mode, mismatch=False, tmp_path=tmp_path)
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank}:\n{out}"
+        assert f"RANK{rank} SAVED" in out
+
+
+def test_mismatched_tags_fail_mode_raises(tmp_path):
+    procs, outs = _run("Fail", mismatch=True, tmp_path=tmp_path)
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank}:\n{out}"
+        assert f"RANK{rank} REJECTED" in out, out
+
+
+def test_mismatched_tags_warn_mode_saves(tmp_path):
+    procs, outs = _run("Warn", mismatch=True, tmp_path=tmp_path)
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank}:\n{out}"
+        assert f"RANK{rank} SAVED" in out, out
