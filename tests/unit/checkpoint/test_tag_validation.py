@@ -98,6 +98,74 @@ def _run(mode, mismatch, tmp_path):
     return procs, outs
 
 
+_ROUNDTRIP_WORKER = r"""
+import os, sys
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import deepspeed_tpu as ds
+from deepspeed_tpu import comm as dist
+from tests.unit.simple_model import SimpleModel, random_dataloader
+
+ds.init_distributed()
+rank = dist.get_rank()
+engine, *_ = ds.initialize(model=SimpleModel(), config={
+    "train_micro_batch_size_per_gpu": 8,
+    "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+    "bf16": {"enabled": True},
+    "zero_optimization": {"stage": 2},
+})
+batch = next(random_dataloader(total_samples=8, batch_size=8))
+for _ in range(2):
+    loss = engine(batch); engine.backward(loss); engine.step()
+engine.save_checkpoint(os.environ["TAG_CKPT_DIR"])
+# reload: orbax hands back GLOBAL arrays across both processes; the load
+# path must reshard them without a local device_put
+engine.load_checkpoint(os.environ["TAG_CKPT_DIR"])
+loss = engine(batch); engine.backward(loss); engine.step()  # still trains
+assert np.isfinite(float(jax.device_get(loss)))
+print(f"RANK{rank} ROUNDTRIP", flush=True)
+"""
+
+
+def test_cross_process_zero2_checkpoint_roundtrip(tmp_path):
+    """Two real processes: ZeRO-2 save -> load -> continue training (the
+    multi-process global-array load path)."""
+    port = _free_port()
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.update(
+            MASTER_ADDR="127.0.0.1",
+            MASTER_PORT=str(port),
+            RANK=str(rank),
+            WORLD_SIZE="2",
+            JAX_PLATFORMS="cpu",
+            PALLAS_AXON_POOL_IPS="",
+            XLA_FLAGS="--xla_force_host_platform_device_count=1",
+            TAG_CKPT_DIR=str(tmp_path / "ck_rt"),
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-c", _ROUNDTRIP_WORKER],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, cwd=repo,
+            )
+        )
+    for rank, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        assert p.returncode == 0, f"rank {rank}:\n{out[-2500:]}"
+        assert f"RANK{rank} ROUNDTRIP" in out
+
+
 @pytest.mark.parametrize("mode", ["Warn", "Ignore"])
 def test_matching_tags_save(mode, tmp_path):
     procs, outs = _run(mode, mismatch=False, tmp_path=tmp_path)
