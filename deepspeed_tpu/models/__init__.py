@@ -1,4 +1,4 @@
-from deepspeed_tpu.models.config import TransformerConfig, bert_config, gpt2_config, llama_config
+from deepspeed_tpu.models.config import TransformerConfig, bert_config, gpt2_config, llama_config, qwen2_config
 from deepspeed_tpu.models.moe_transformer import (
     MoETransformerConfig,
     MoETransformerLM,

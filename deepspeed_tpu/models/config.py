@@ -142,6 +142,33 @@ def llama_config(size: str = "7b", **overrides) -> TransformerConfig:
     return TransformerConfig(**base)
 
 
+def qwen2_config(size: str = "7b", **overrides) -> TransformerConfig:
+    """Qwen2 family: the llama body (RMSNorm + RoPE + SwiGLU, no output
+    biases) with BIASED q/k/v projections and GQA."""
+    presets = {
+        "tiny": dict(hidden_size=256, num_layers=4, num_heads=8, num_kv_heads=2,
+                     vocab_size=1024, max_seq_len=512),
+        "0.5b": dict(hidden_size=896, num_layers=24, num_heads=14, num_kv_heads=2,
+                     intermediate_size=4864, vocab_size=151936, tie_embeddings=True),
+        "7b": dict(hidden_size=3584, num_layers=28, num_heads=28, num_kv_heads=4,
+                   intermediate_size=18944, vocab_size=152064, max_seq_len=4096),
+    }
+    base = dict(
+        norm="rmsnorm",
+        norm_eps=1e-6,
+        position="rope",
+        rope_theta=1e6,  # all Qwen2 sizes use base 1e6 (like mixtral_config)
+        activation="swiglu",
+        use_bias=False,
+        qkv_bias=True,
+        tie_embeddings=False,
+        max_seq_len=2048,
+    )
+    base.update(presets[size])
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
 def bert_config(size: str = "large", **overrides) -> TransformerConfig:
     """Encoder config: bidirectional (non-causal) attention."""
     presets = {

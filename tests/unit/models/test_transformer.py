@@ -132,3 +132,34 @@ def test_tp_sharding_rules_applied(eight_devices):
     engine.step()
     assert "model" in str(engine.get_params()["layers"]["wq"].sharding.spec)
     assert np.isfinite(float(jax.device_get(loss)))
+
+
+def test_qwen2_preset_trains(eight_devices):
+    """Qwen2 family: llama body + biased q/k/v + GQA — params carry the
+    qkv biases and a short training run learns."""
+    import deepspeed_tpu as ds
+    import deepspeed_tpu.parallel.mesh as mesh_mod
+    from deepspeed_tpu.models import qwen2_config
+
+    mesh_mod.reset_topology()
+    cfg = qwen2_config("tiny", num_layers=2, remat=False)
+    assert cfg.qkv_bias and not cfg.use_bias
+    assert cfg.rope_theta == 1e6
+    model = TransformerLM(cfg)
+    engine, *_ = ds.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 1},
+    })
+    batch = _batch(cfg.vocab_size, b=8, t=32)
+    losses = []
+    for _ in range(5):
+        loss = engine(batch)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(jax.device_get(loss)))
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0], losses
+    layers = engine.get_params()["layers"]
+    assert "bq" in layers and "bo" not in layers  # biased qkv, bias-free output
