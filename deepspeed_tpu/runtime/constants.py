@@ -29,6 +29,7 @@ ADAMW_OPTIMIZER = "adamw"
 FUSED_ADAM_OPTIMIZER = "fusedadam"
 CPU_ADAM_OPTIMIZER = "deepspeedcpuadam"
 CPU_ADAGRAD_OPTIMIZER = "deepspeedcpuadagrad"
+ADAGRAD_OPTIMIZER = "adagrad"
 LAMB_OPTIMIZER = "lamb"
 FUSED_LAMB_OPTIMIZER = "fusedlamb"
 ONEBIT_ADAM_OPTIMIZER = "onebitadam"
@@ -42,6 +43,7 @@ DEEPSPEED_OPTIMIZERS = [
     FUSED_ADAM_OPTIMIZER,
     CPU_ADAM_OPTIMIZER,
     CPU_ADAGRAD_OPTIMIZER,
+    ADAGRAD_OPTIMIZER,
     LAMB_OPTIMIZER,
     FUSED_LAMB_OPTIMIZER,
     ONEBIT_ADAM_OPTIMIZER,

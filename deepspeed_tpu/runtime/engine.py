@@ -76,6 +76,7 @@ _OPTIMIZER_REGISTRY = {
     C.FUSED_ADAM_OPTIMIZER: FusedAdam,
     C.CPU_ADAM_OPTIMIZER: FusedAdam,  # host-offload variant selected via zero config
     C.CPU_ADAGRAD_OPTIMIZER: DeepSpeedCPUAdagrad,
+    C.ADAGRAD_OPTIMIZER: DeepSpeedCPUAdagrad,
     C.LAMB_OPTIMIZER: FusedLamb,
     C.FUSED_LAMB_OPTIMIZER: FusedLamb,
     C.SGD_OPTIMIZER: SGD,

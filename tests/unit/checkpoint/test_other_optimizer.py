@@ -1,0 +1,96 @@
+"""Checkpoint round-trips per optimizer family (reference:
+tests/unit/checkpoint/test_other_optimizer.py): each optimizer carries a
+different state tree (moments, trust ratios, error feedback, accumulators)
+and all of it must survive save -> fresh engine -> load -> identical
+continued trajectory."""
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+import deepspeed_tpu.parallel.mesh as mesh_mod
+from tests.unit.simple_model import SimpleModel, random_dataloader
+
+
+def _cfg(opt_type, **opt_params):
+    params = {"lr": 1e-2}
+    params.update(opt_params)
+    return {
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": opt_type, "params": params},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 1},
+    }
+
+
+def _engine(cfg):
+    mesh_mod.reset_topology()
+    engine, *_ = ds.initialize(model=SimpleModel(), config=cfg)
+    return engine
+
+
+def _step(engine, batch):
+    loss = engine(batch)
+    engine.backward(loss)
+    engine.step()
+    return float(jax.device_get(loss))
+
+
+@pytest.mark.parametrize(
+    "opt_type,opt_params",
+    [
+        ("adam", {}),
+        ("adamw", {"weight_decay": 0.01}),
+        ("lamb", {}),
+        ("adagrad", {}),
+        ("sgd", {"momentum": 0.9}),
+    ],
+)
+def test_checkpoint_roundtrip_preserves_optimizer_state(tmp_path, opt_type, opt_params):
+    cfg = _cfg(opt_type, **opt_params)
+    batch = next(random_dataloader(total_samples=8, batch_size=8))
+
+    # uninterrupted: 6 steps
+    ref = _engine(cfg)
+    ref_losses = [_step(ref, batch) for _ in range(6)]
+
+    # interrupted at step 3
+    a = _engine(cfg)
+    for _ in range(3):
+        _step(a, batch)
+    a.save_checkpoint(str(tmp_path / opt_type))
+
+    b = _engine(cfg)
+    b.init_params(batch)
+    b.load_checkpoint(str(tmp_path / opt_type))
+    resumed = [_step(b, batch) for _ in range(3)]
+
+    # optimizer state (moments/accumulators/momentum) resumed exactly:
+    # the continued trajectory matches the uninterrupted one
+    assert resumed == pytest.approx(ref_losses[3:], rel=1e-5), (
+        opt_type,
+        resumed,
+        ref_losses[3:],
+    )
+
+
+def test_fresh_optimizer_diverges_without_state(tmp_path):
+    """Control: loading weights only (fresh moments) must NOT reproduce the
+    uninterrupted trajectory — proving the test above really exercises
+    optimizer-state restoration."""
+    cfg = _cfg("adam")
+    batch = next(random_dataloader(total_samples=8, batch_size=8))
+    ref = _engine(cfg)
+    ref_losses = [_step(ref, batch) for _ in range(6)]
+
+    a = _engine(cfg)
+    for _ in range(3):
+        _step(a, batch)
+    a.save_checkpoint(str(tmp_path))
+
+    b = _engine(cfg)
+    b.init_params(batch)
+    b.load_checkpoint(str(tmp_path), load_optimizer_states=False)
+    resumed = [_step(b, batch) for _ in range(3)]
+    assert resumed != pytest.approx(ref_losses[3:], rel=1e-6)
