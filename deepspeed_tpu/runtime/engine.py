@@ -84,6 +84,7 @@ _OPTIMIZER_REGISTRY = {
 
 
 class DeepSpeedEngine:
+    _is_pipe_engine = False
     def __init__(
         self,
         args=None,
@@ -362,6 +363,93 @@ class DeepSpeedEngine:
     def gradient_accumulation_steps(self) -> int:
         return self._config.gradient_accumulation_steps
 
+    def set_train_batch_size(self, train_batch_size: int) -> None:
+        """Resize the global batch by changing the number of micro-batches
+        (gradient-accumulation steps); the micro-batch size is unchanged
+        (reference engine.py:403 — the elasticity resize hook).
+
+        Structural here: gas=1 fuses the optimizer into the forward program
+        and gas>1 accumulates into a buffer, so crossing that boundary
+        rebuilds the jitted programs and (de)allocates the accumulator."""
+        micro = self.train_micro_batch_size_per_gpu()
+        dp = max(1, self.data_parallel_world_size())
+        if train_batch_size % (micro * dp) != 0:
+            raise ValueError(
+                "Train batch size must be divisible by micro-batch * data "
+                f"parallelism ({micro} * {dp})"
+            )
+        new_gas = train_batch_size // (micro * dp)
+        if new_gas < 1:
+            raise ValueError(
+                f"train_batch_size={train_batch_size} is below one micro-batch "
+                f"per data shard ({micro} * {dp})"
+            )
+        if new_gas == self.gradient_accumulation_steps():
+            self._config.train_batch_size = train_batch_size
+            self.tput_timer.batch_size = train_batch_size
+            return
+        self._check_resize_allowed()
+        if self._is_pipe_engine:
+            # the pipeline folds all microbatches into one compiled schedule
+            # sized at construction — a live resize cannot reshape it
+            raise NotImplementedError(
+                "set_train_batch_size is unsupported on the pipeline engine"
+            )
+        self._config.train_batch_size = train_batch_size
+        self._config.gradient_accumulation_steps = new_gas
+        self._gas_divisor = new_gas
+        # re-base the window counter: boundary math is micro_steps % gas,
+        # and an old count that is not a multiple of the NEW gas would make
+        # the first window short with a wrong 1/gas divisor
+        self.micro_steps = 0
+        self.tput_timer.batch_size = train_batch_size
+        if self._initialized:
+            self._build_jitted_fns()
+            if self._fused_step_enabled:
+                self._grad_acc = None
+            elif self._grad_acc is None:
+                self._grad_acc = self._alloc_grad_acc()
+        log_dist(
+            f"set_train_batch_size: train_batch={train_batch_size} gas={new_gas}",
+            ranks=[0],
+        )
+
+    def _check_resize_allowed(self) -> None:
+        if self._in_forward or self._pending_commit is not None:
+            raise RuntimeError("cannot resize the batch mid-step: finish backward()+step() first")
+        if self.micro_steps % self.gradient_accumulation_steps() != 0:
+            raise RuntimeError(
+                "cannot resize the batch inside an accumulation window: "
+                "step() must complete the current window first"
+            )
+        if self._param_stream is not None or self._host_offload is not None:
+            raise NotImplementedError(
+                "batch resizing is unsupported on the offload paths"
+            )
+
+    def _alloc_grad_acc(self):
+        """Zeroed gradient-accumulation buffer in the configured dtype with
+        the grad shardings (used at init and after a gas resize)."""
+        acc_dtype = self._grad_accum_dtype()
+        zeros_acc = jax.jit(
+            lambda t: jax.tree_util.tree_map(lambda x: jnp.zeros(x.shape, acc_dtype), t),
+            out_shardings=self._grad_shardings,
+        )
+        return zeros_acc(self._params)
+
+    def set_train_micro_batch_size(self, micro_batch_size: int) -> None:
+        """Change the micro-batch size, keeping gas fixed (reference
+        engine.py:421). Shapes change, so the jitted programs retrace on the
+        next forward automatically; only the config bookkeeping lives here."""
+        if micro_batch_size < 1:
+            raise ValueError(f"micro_batch_size={micro_batch_size} must be >= 1")
+        self._check_resize_allowed()
+        gas = self.gradient_accumulation_steps()
+        dp = max(1, self.data_parallel_world_size())
+        self._config.train_batch_size = micro_batch_size * gas * dp
+        self._config.train_micro_batch_size_per_gpu = micro_batch_size
+        self.tput_timer.batch_size = self._config.train_batch_size
+
     def zero_optimization_stage(self) -> int:
         return self._config.zero_optimization_stage
 
@@ -560,12 +648,7 @@ class DeepSpeedEngine:
             # dtype follows data_types.grad_accum_dtype (reference
             # engine.py get_data_types; fp32 default — bf16 halves the
             # buffer for gas>1 at reduced accumulation precision)
-            acc_dtype = self._grad_accum_dtype()
-            zeros_acc = jax.jit(
-                lambda t: jax.tree_util.tree_map(lambda x: jnp.zeros(x.shape, acc_dtype), t),
-                out_shardings=grad_shardings,
-            )
-            self._grad_acc = zeros_acc(self._params)
+            self._grad_acc = self._alloc_grad_acc()
         self._initialized = True
         n_params = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(self._params))
         log_dist(f"Initialized model state: {n_params:,} parameters", ranks=[0])

@@ -26,6 +26,7 @@ from deepspeed_tpu.utils.logging import log_dist
 
 
 class PipelineEngine(DeepSpeedEngine):
+    _is_pipe_engine = True
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
         self.num_stages = self.topology.get_pipe_parallel_world_size()

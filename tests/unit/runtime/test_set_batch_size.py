@@ -1,0 +1,114 @@
+"""Elastic batch resizing (reference: engine.py:403 set_train_batch_size,
+:421 set_train_micro_batch_size): gas changes rebuild the fused/accumulating
+step structure; micro changes retrace on shape."""
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+import deepspeed_tpu.parallel.mesh as mesh_mod
+from tests.unit.simple_model import SimpleModel, random_dataloader
+
+
+def _engine(gas=1, micro=1):
+    mesh_mod.reset_topology()
+    engine, *_ = ds.initialize(
+        model=SimpleModel(),
+        config={
+            "train_micro_batch_size_per_gpu": micro,
+            "gradient_accumulation_steps": gas,
+            "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 1},
+        },
+    )
+    return engine
+
+
+def _steps(engine, n, batch):
+    losses = []
+    for _ in range(n):
+        loss = engine(batch)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(jax.device_get(loss)))
+    return losses
+
+
+def test_grow_gas_switches_to_accumulating(eight_devices):
+    engine = _engine(gas=1)
+    batch = next(random_dataloader(total_samples=8, batch_size=8))
+    _steps(engine, 2, batch)
+    assert engine._fused_step_enabled and engine._grad_acc is None
+
+    engine.set_train_batch_size(16)  # micro(1) x dp(8) x gas(2)
+    assert engine.gradient_accumulation_steps() == 2
+    assert engine.train_batch_size() == 16
+    assert not engine._fused_step_enabled
+    assert engine._grad_acc is not None  # buffer allocated on the switch
+    losses = _steps(engine, 4, batch)  # two full windows
+    assert all(np.isfinite(l) for l in losses)
+
+
+def test_shrink_gas_back_to_fused(eight_devices):
+    engine = _engine(gas=2)
+    batch = next(random_dataloader(total_samples=8, batch_size=8))
+    _steps(engine, 2, batch)  # one full window
+    assert not engine._fused_step_enabled
+
+    engine.set_train_batch_size(8)  # gas -> 1
+    assert engine._fused_step_enabled and engine._grad_acc is None
+    losses = _steps(engine, 2, batch)
+    assert all(np.isfinite(l) for l in losses)
+
+
+def test_resize_rebases_window_counter(eight_devices):
+    """micro_steps=4 then gas 1->3: without re-basing, the first window
+    would be short and its grads divided by the wrong divisor."""
+    engine = _engine(gas=1)
+    batch = next(random_dataloader(total_samples=8, batch_size=8))
+    _steps(engine, 4, batch)
+    assert engine.micro_steps == 4
+    engine.set_train_batch_size(24)  # gas=3
+    assert engine.micro_steps == 0  # re-based: windows align with new gas
+    for i in range(3):
+        loss = engine(batch)
+        engine.backward(loss)
+        engine.step()
+        boundary_done = engine.micro_steps % 3 == 0
+        assert boundary_done == (i == 2)  # exactly one full 3-step window
+
+
+def test_zero_batch_rejected(eight_devices):
+    engine = _engine(gas=1)
+    with pytest.raises(ValueError, match="below one micro-batch"):
+        engine.set_train_batch_size(0)
+    with pytest.raises(ValueError, match="must be >= 1"):
+        engine.set_train_micro_batch_size(0)
+
+
+def test_indivisible_rejected(eight_devices):
+    engine = _engine(gas=1)
+    with pytest.raises(ValueError, match="divisible"):
+        engine.set_train_batch_size(12)  # not a multiple of 8
+
+
+def test_mid_window_resize_rejected(eight_devices):
+    engine = _engine(gas=2)
+    batch = next(random_dataloader(total_samples=8, batch_size=8))
+    loss = engine(batch)
+    engine.backward(loss)
+    engine.step()  # half a window
+    with pytest.raises(RuntimeError, match="accumulation window"):
+        engine.set_train_batch_size(8)
+
+
+def test_set_micro_batch_size_updates_triad(eight_devices):
+    engine = _engine(gas=2)
+    engine.set_train_micro_batch_size(2)
+    assert engine.train_micro_batch_size_per_gpu() == 2
+    assert engine.train_batch_size() == 2 * 2 * 8  # micro x gas x dp
+    batch = next(random_dataloader(total_samples=16, batch_size=16))
+    losses = _steps(engine, 2, batch)  # shape change -> clean retrace
+    assert all(np.isfinite(l) for l in losses)
