@@ -60,6 +60,7 @@ class DeepSpeedDataLoader:
         self.shuffle = shuffle
         self.seed = seed
         self.drop_last = drop_last
+        self.post_process_func = None
         self.data_sampler = data_sampler
         self.epoch = 0
         try:
@@ -90,14 +91,20 @@ class DeepSpeedDataLoader:
     def __iter__(self):
         if self._len is None:
             # iterable dataset: batch on the fly
-            yield from self._iter_stream()
+            for batch in self._iter_stream():
+                yield self._post(batch)
             return
         order = self._indices()
         n_batches = len(self)
         for b in range(n_batches):
             idx = order[b * self.batch_size : (b + 1) * self.batch_size]
             items = [self.dataset[int(i)] for i in idx]
-            yield self.collate_fn(items)
+            yield self._post(self.collate_fn(items))
+
+    def _post(self, batch):
+        """Data-efficiency hook (reference engine.set_data_post_process_func
+        -> dataloader.post_process_func): applied to each emitted batch."""
+        return self.post_process_func(batch) if self.post_process_func else batch
 
     def _iter_stream(self):
         buf = []

@@ -477,6 +477,45 @@ class DeepSpeedEngine:
     def get_lr(self):
         return self.optimizer.get_lr()
 
+    def set_data_post_process_func(self, post_process_func) -> None:
+        """Install a per-batch transform on the engine dataloader
+        (reference engine.py:433 — the data-efficiency post-process hook)."""
+        if self.training_dataloader is not None:
+            self.training_dataloader.post_process_func = post_process_func
+
+    def set_custom_curriculum_learning_schedule(self, schedule_func_dict) -> None:
+        """Install custom curriculum schedule functions (reference
+        engine.py:437): a bare callable drives the engine's (seqlen)
+        scheduler; the reference's {metric_name: fn} dict routes per metric —
+        'seqlen' to the engine scheduler, any other single metric to the
+        curriculum data sampler's scheduler."""
+        if callable(schedule_func_dict):
+            if self.curriculum_scheduler is None:
+                raise ValueError("curriculum learning is not enabled")
+            self.curriculum_scheduler.set_custom_get_difficulty(schedule_func_dict)
+            return
+        if not isinstance(schedule_func_dict, dict):
+            raise TypeError(
+                "expected a callable or a {metric_name: schedule_fn} dict, "
+                f"got {type(schedule_func_dict).__name__}"
+            )
+        sampler = getattr(self.training_dataloader, "data_sampler", None)
+        sampler_sched = getattr(sampler, "scheduler", None)
+        for metric, fn in schedule_func_dict.items():
+            if not callable(fn):
+                raise TypeError(f"schedule for metric {metric!r} is not callable")
+            if metric in ("seqlen", "default") and self.curriculum_scheduler is not None:
+                self.curriculum_scheduler.set_custom_get_difficulty(fn)
+            elif sampler_sched is not None:
+                sampler_sched.set_custom_get_difficulty(fn)
+            elif self.curriculum_scheduler is not None:
+                self.curriculum_scheduler.set_custom_get_difficulty(fn)
+            else:
+                raise ValueError(
+                    f"no curriculum scheduler to receive metric {metric!r} "
+                    "(enable curriculum_learning or use a curriculum sampler)"
+                )
+
     def get_global_grad_norm(self) -> Optional[float]:
         if self._last_grad_norm is None:
             return None

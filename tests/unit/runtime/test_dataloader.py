@@ -72,3 +72,53 @@ def test_iterable_dataset():
     batches = list(loader)
     assert len(batches) == 2
     assert batches[0].shape == (4, 2)
+
+
+def test_post_process_func_applied():
+    """reference engine.set_data_post_process_func: the hook transforms
+    every emitted batch."""
+    from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+
+    data = [(np.full((2,), float(i)), np.zeros((2,))) for i in range(8)]
+    loader = DeepSpeedDataLoader(data, batch_size=4, shuffle=False)
+    loader.post_process_func = lambda batch: (batch[0] + 100.0, batch[1])
+    xs = [b[0] for b in loader]
+    assert all((x >= 100.0).all() for x in xs)
+
+
+def test_engine_data_efficiency_hooks(eight_devices):
+    """engine.set_data_post_process_func + set_custom_curriculum_learning_schedule
+    (reference engine.py:433,437)."""
+    import deepspeed_tpu as ds
+    import deepspeed_tpu.parallel.mesh as mesh_mod
+    from tests.unit.simple_model import SimpleModel
+
+    mesh_mod.reset_topology()
+    data = [(np.random.RandomState(i).randn(16).astype(np.float32),
+             np.zeros(16, np.float32)) for i in range(16)]
+    engine, _, loader, _ = ds.initialize(
+        model=SimpleModel(),
+        config={
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+            "bf16": {"enabled": True},
+            "curriculum_learning": {
+                "enabled": True, "curriculum_type": "seqlen",
+                "min_difficulty": 8, "max_difficulty": 16,
+                # reference semantics: the custom function installed via
+                # set_custom_curriculum_learning_schedule only drives the
+                # "custom" schedule type
+                "schedule_type": "custom",
+            },
+        },
+        training_data=data,
+    )
+    marks = []
+    engine.set_data_post_process_func(lambda b: (marks.append(1), b)[1])
+    batch = next(iter(loader))
+    assert marks, "post-process hook did not run"
+
+    seen = []
+    engine.set_custom_curriculum_learning_schedule(lambda step: seen.append(step) or 16)
+    assert engine.curriculum_scheduler.update_difficulty(3) == 16
+    assert seen == [3]
