@@ -44,7 +44,37 @@ SEED = 0
 NORTH_STAR_MFU = 0.40
 # DS_BENCH_TINY=1: shrink every config so the whole bench smoke-tests on CPU
 TINY = os.environ.get("DS_BENCH_TINY") == "1"
+# Tiny mode (or an explicit JAX_PLATFORMS=cpu) means CPU-only: children must
+# not touch the axon/TPU tunnel at all. The axon sitecustomize registers the
+# PJRT plugin in EVERY python process via PYTHONPATH, and backend init then
+# dials the (possibly down) tunnel even when the caller asked for cpu — so
+# CPU children need the axon env stripped, not just JAX_PLATFORMS=cpu.
+CPU_ONLY = TINY or os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"
 REPO = os.path.dirname(os.path.abspath(__file__))
+
+# Canonical metric name per config — single source of truth for the return
+# dicts below AND for error/stale records, so every BENCH file keys
+# consistently and the known-good store never drifts from the emit path.
+METRICS = {
+    "gpt2_zero1": "gpt2_125m_zero1_tokens_per_sec_per_chip",
+    "llama_zero3": "llama_0p8b_zero3_tokens_per_sec_per_chip",
+    "infinity": "zero_infinity_trainable_params_per_chip",
+    "long_seq": "seq32k_flash_tokens_per_sec_per_chip",
+    "moe_inference": "moe8x_top1_prefill_tokens_per_sec",
+}
+
+
+def _child_env():
+    """Environment for bench children. In CPU_ONLY mode, force the cpu
+    backend and remove the axon plugin triggers so sitecustomize doesn't
+    register the tunnel-backed PJRT plugin (see CPU_ONLY comment)."""
+    env = dict(os.environ)
+    if CPU_ONLY:
+        env["JAX_PLATFORMS"] = "cpu"
+        for key in ("PALLAS_AXON_POOL_IPS", "AXON_POOL_SVC_OVERRIDE",
+                    "PALLAS_AXON_REMOTE_COMPILE", "AXON_LOOPBACK_RELAY"):
+            env.pop(key, None)
+    return env
 
 
 def _enable_compile_cache():
@@ -160,7 +190,7 @@ def bench_gpt2_zero1():
     tps_chip = 20 * micro * n_chips * seq / dt / n_chips
     mfu = _mfu(tps_chip, engine.num_parameters(), mcfg.num_layers, mcfg.hidden_size, seq)
     return {
-        "metric": "gpt2_125m_zero1_tokens_per_sec_per_chip",
+        "metric": METRICS["gpt2_zero1"],
         "value": round(tps_chip, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / NORTH_STAR_MFU, 4),
@@ -209,7 +239,7 @@ def bench_llama_zero3():
     # remat recomputes the forward in the backward: the chip does ~8N useful
     # FLOPs/token but MFU counts the 6N model FLOPs (standard accounting)
     return {
-        "metric": "llama_0p8b_zero3_tokens_per_sec_per_chip",
+        "metric": METRICS["llama_zero3"],
         "value": round(tps, 1),
         "unit": "tokens/s/chip",
         "steps": steps,
@@ -261,7 +291,7 @@ def bench_infinity_max_params():
     assert np.isfinite(float(loss)), "non-finite streamed loss"
     n_params = engine.num_parameters()
     return {
-        "metric": "zero_infinity_trainable_params_per_chip",
+        "metric": METRICS["infinity"],
         "value": int(n_params),
         "unit": f"params (1 step {step_s:.1f}s, loss {float(loss):.3f})",
         "vs_baseline": round(n_params / 1.0e9, 2),
@@ -308,7 +338,7 @@ def bench_long_seq():
     tps = steps * micro * seq / dt
     mfu = _mfu(tps, engine.num_parameters(), mcfg.num_layers, mcfg.hidden_size, seq)
     return {
-        "metric": "seq32k_flash_tokens_per_sec_per_chip",
+        "metric": METRICS["long_seq"],
         "value": round(tps, 1),
         "unit": "tokens/s/chip",
         "steps": steps,
@@ -362,7 +392,7 @@ def bench_moe_inference():
     )
     dense_tps = prefill_tps(TransformerLM(TransformerConfig(**base)))
     return {
-        "metric": "moe8x_top1_prefill_tokens_per_sec",
+        "metric": METRICS["moe_inference"],
         "value": round(moe_tps, 1),
         "unit": "tokens/s",
         "vs_baseline": round(moe_tps / dense_tps, 4),
@@ -383,11 +413,57 @@ CONFIGS = {
 }
 HEADLINE = "gpt2_zero1"
 PARTIAL_PATH = os.path.join(REPO, "bench_partial.jsonl")
+KNOWN_GOOD_PATH = os.path.join(REPO, "bench_known_good.json")
+
+
+def _load_known_good():
+    """metric -> last real (hardware, non-error) record, persisted across
+    rounds. A down-tunnel round re-emits these tagged ``"stale": true`` so
+    the last real measurement is never lost to a tunnel flap."""
+    try:
+        with open(KNOWN_GOOD_PATH) as f:
+            return json.load(f)
+    except Exception:
+        return {}
+
+
+def _save_known_good(store):
+    try:
+        with open(KNOWN_GOOD_PATH, "w") as f:
+            json.dump(store, f, indent=1, sort_keys=True)
+    except Exception:
+        pass
+
+
+def _record_known_good(store, rec, platform):
+    """Remember a real measurement: hardware (non-cpu) platform only, never
+    errors, never re-emitted stale records. Gating on the PROBED platform —
+    not the env flags — keeps a full-size run that silently landed on the
+    cpu backend from overwriting the TPU record."""
+    if CPU_ONLY or platform in (None, "cpu") or rec.get("stale") or not rec.get("value"):
+        return
+    if str(rec.get("unit", "")).startswith(("error:", "skipped:")):
+        return
+    entry = dict(rec)
+    entry["recorded_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    store[rec["metric"]] = entry
+    _save_known_good(store)
+
+
+def _stale_or_error(store, name, msg):
+    """Prefer re-emitting the last known-good number (tagged stale) over an
+    all-zero error line; fall back to the error record."""
+    known = store.get(METRICS[name])
+    if known is not None:
+        rec = dict(known)
+        rec["stale"] = True
+        rec["stale_reason"] = f"this run: {msg[:120]}"
+        return rec
+    return _error_record(name, msg)
 
 
 def _error_record(name, msg):
-    fn, _ = CONFIGS[name]
-    return {"metric": fn.__name__, "value": 0, "unit": f"error: {msg[:160]}", "vs_baseline": 0}
+    return {"metric": METRICS[name], "value": 0, "unit": f"error: {msg[:160]}", "vs_baseline": 0}
 
 
 def _run_child(args, timeout_s, log_path):
@@ -401,6 +477,7 @@ def _run_child(args, timeout_s, log_path):
             stderr=subprocess.STDOUT,
             start_new_session=True,
             cwd=REPO,
+            env=_child_env(),
         )
         try:
             return proc.wait(timeout=timeout_s), False
@@ -414,19 +491,32 @@ def _run_child(args, timeout_s, log_path):
 
 
 def _probe(budget_left):
-    """Probe the backend with short timeouts; returns (platform|None, detail).
-    The tunnel either answers in seconds or is down for hours — short
-    retries catch transient flake without burning the budget on a stall.
+    """Probe the backend until it answers or the budget is nearly gone;
+    returns (platform|None, detail).
+
+    Retries are spread across the WHOLE budget, not front-loaded: the
+    round-4 capture hit an 18-minute tunnel outage inside a 22-minute
+    budget — a probe that gave up in the first 4 minutes missed the window
+    that opened later. Short per-attempt timeouts (75s) + a between-attempt
+    sleep keep each attempt killable while covering the full window.
+
     The result file, not the child's rc, is the success signal: a child that
     wrote it and then hung in backend teardown still counts."""
+    if CPU_ONLY:
+        return "cpu", "cpu-only mode: tunnel probe bypassed"
     log = os.path.join(REPO, "bench_child_probe.log")
     out_path = os.path.join(REPO, ".bench_probe.json")
-    attempts = 3
     detail = "no probe ran"
-    for attempt in range(attempts):
+    attempt = 0
+    fast_failures = 0
+    # Stop once even a warm-cache headline run could no longer fit
+    # (run_config skips configs below 75s left); stale/error emission after
+    # the loop needs only seconds.
+    while budget_left() > 90:
+        attempt += 1
         if os.path.exists(out_path):
             os.remove(out_path)
-        timeout_s = min(75, max(20, budget_left()))
+        timeout_s = min(75, max(20, budget_left() - 30))
         rc, timed_out = _run_child(["--child-probe"], timeout_s, log)
         if os.path.exists(out_path):
             try:
@@ -435,14 +525,19 @@ def _probe(budget_left):
             except Exception:
                 return "unknown", "ok"
         detail = (
-            f"probe {attempt + 1}/{attempts} "
+            f"probe attempt {attempt} "
             + (f"timed out after {timeout_s:.0f}s" if timed_out else f"exited rc={rc}")
         )
         print(f"[bench] {detail}", file=sys.stderr, flush=True)
-        if budget_left() < 90:
-            break
-        if attempt < attempts - 1:
-            time.sleep(5 * (attempt + 1))
+        # A timeout means the tunnel is stalling — retrying across the whole
+        # budget catches a window that opens later. A FAST non-timeout exit
+        # is deterministic (import error, bad env): retrying forever would
+        # burn the budget in a tight spawn loop, so cap those.
+        if not timed_out:
+            fast_failures += 1
+            if fast_failures >= 3:
+                return None, detail + " (deterministic failure, giving up)"
+        time.sleep(min(20, max(2, budget_left() - 75)))
     return None, detail
 
 
@@ -481,6 +576,7 @@ def main():
 
     open(PARTIAL_PATH, "w").close()
     results = {}
+    known_good = _load_known_good()
 
     def emit(rec):
         line = json.dumps(rec)
@@ -490,11 +586,15 @@ def main():
 
     platform, probe_detail = _probe(budget_left)
     if platform is None:
-        # No usable backend at all: emit honest error lines and exit 0 so the
-        # driver records parsed (non-null) output instead of a timeout.
+        # No usable backend this run: re-emit the last real measurement per
+        # config tagged "stale": true (VERDICT r4 weak #1 — a tunnel flap
+        # must not erase the last hardware number from the round's record),
+        # falling back to an honest error line where none exists. Exit 0 so
+        # the driver records parsed output instead of a timeout.
+        msg = f"backend unavailable: {probe_detail}"
         for name in CONFIGS:
-            emit(_error_record(name, f"backend unavailable: {probe_detail}"))
-        emit(_error_record(HEADLINE, f"backend unavailable: {probe_detail}"))
+            emit(_stale_or_error(known_good, name, msg))
+        emit(_stale_or_error(known_good, HEADLINE, msg))
         return
     print(f"[bench] backend ready: {platform}", file=sys.stderr, flush=True)
 
@@ -532,21 +632,38 @@ def main():
             print(f"[bench] retrying {name}", file=sys.stderr, flush=True)
         return _error_record(name, "unreachable")
 
+    def finalize(name, rec):
+        """Record real hardware numbers; degrade error lines to stale
+        re-emits. In CPU_ONLY smoke mode keep the honest error line — a
+        stale TPU number would mask a broken tiny config and mix hardware
+        numbers into a CPU-only output."""
+        unit = str(rec.get("unit", ""))
+        if unit.startswith(("error:", "skipped:")):
+            if not CPU_ONLY:
+                msg = unit[len("error: "):] if unit.startswith("error: ") else unit
+                rec = _stale_or_error(known_good, name, msg)
+        else:
+            _record_known_good(known_good, rec, platform)
+        results[name] = rec
+        return rec
+
     # Headline first — on record even if everything after stalls.
-    results[HEADLINE] = run_config(HEADLINE, retries=1)
-    emit(results[HEADLINE])
+    emit(finalize(HEADLINE, run_config(HEADLINE, retries=1)))
     for name in ("llama_zero3", "infinity", "long_seq", "moe_inference"):
-        results[name] = run_config(name)
-        emit(results[name])
+        emit(finalize(name, run_config(name)))
 
     # The driver parses the LAST line as the headline, so the last line is
     # ALWAYS config 1's record — never a different config mislabeled as the
     # headline. If the headline errored earlier but budget remains, give it
     # one more try now (the compile cache is warm from the earlier attempts).
-    if str(results[HEADLINE].get("unit", "")).startswith("error:") and budget_left() > 120:
+    headline_is_fresh = not (
+        results[HEADLINE].get("stale")
+        or str(results[HEADLINE].get("unit", "")).startswith("error:")
+    )
+    if not headline_is_fresh and budget_left() > 120:
         retry = run_config(HEADLINE)
-        if not str(retry.get("unit", "")).startswith("error:"):
-            results[HEADLINE] = retry
+        if not str(retry.get("unit", "")).startswith(("error:", "skipped:")):
+            finalize(HEADLINE, retry)
     emit(results[HEADLINE])
 
 

@@ -1,0 +1,38 @@
+"""Flatten/unflatten a list of arrays into one contiguous 1-D buffer.
+
+TPU analogue of the reference's ``UtilsBuilder`` op (csrc flatten/unflatten
+bound via op_builder/utils.py; used by the reference's ZeRO bucketing and
+``deepspeed.runtime.utils``). Under XLA there is no apex to bind — the ops
+are plain jnp concatenate/slice, which XLA fuses into the surrounding
+program — but the module keeps the same two-function contract so code
+written against ``UtilsBuilder().load()`` ports directly.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["flatten", "unflatten"]
+
+
+def flatten(tensors: Sequence[jnp.ndarray]) -> jnp.ndarray:
+    """Concatenate ``tensors`` (any shapes) into one contiguous 1-D array,
+    mirroring ``torch._utils._flatten_dense_tensors``."""
+    if not tensors:
+        return jnp.zeros((0,), dtype=jnp.float32)
+    return jnp.concatenate([jnp.ravel(t) for t in tensors])
+
+
+def unflatten(flat: jnp.ndarray, tensors: Sequence[jnp.ndarray]) -> list:
+    """Split 1-D ``flat`` back into views shaped like ``tensors``, mirroring
+    ``torch._utils._unflatten_dense_tensors``."""
+    outputs = []
+    offset = 0
+    for t in tensors:
+        numel = int(np.prod(t.shape)) if t.ndim else 1
+        outputs.append(jnp.reshape(flat[offset : offset + numel], t.shape))
+        offset += numel
+    return outputs

@@ -52,7 +52,7 @@ class FusedAdamBuilder(_ModuleOpBuilder):
 
 class CPUAdamBuilder(_ModuleOpBuilder):
     NAME = "cpu_adam"
-    MODULE = "deepspeed_tpu.ops.host_optimizer"
+    MODULE = "deepspeed_tpu.ops.adam.cpu_adam_native"
 
     def is_compatible(self, verbose: bool = True) -> bool:  # noqa: ARG002
         try:
@@ -79,7 +79,7 @@ class TransformerBuilder(_ModuleOpBuilder):
 
 class InferenceBuilder(_ModuleOpBuilder):
     NAME = "transformer_inference"
-    MODULE = "deepspeed_tpu.ops.transformer.inference"
+    MODULE = "deepspeed_tpu.ops.transformer.decode_attention"
 
 
 class QuantizerBuilder(_ModuleOpBuilder):
@@ -94,12 +94,12 @@ class SparseAttnBuilder(_ModuleOpBuilder):
 
 class RandomLTDBuilder(_ModuleOpBuilder):
     NAME = "random_ltd"
-    MODULE = "deepspeed_tpu.ops.random_ltd"
+    MODULE = "deepspeed_tpu.runtime.data_pipeline.data_routing"
 
 
 class SpatialInferenceBuilder(_ModuleOpBuilder):
     NAME = "spatial_inference"
-    MODULE = "deepspeed_tpu.ops.spatial"
+    MODULE = "deepspeed_tpu.models.unet"
 
 
 class AsyncIOBuilder(_ModuleOpBuilder):

@@ -1,0 +1,46 @@
+"""Every registered op builder must be loadable.
+
+Reference contract: ``op_builder/builder.py:102`` — ``OpBuilder.load()``
+returns the op module; ``is_compatible()`` gates it. Round-4 verdict found 5
+of 12 registry entries pointing at modules that did not exist (passing
+``is_compatible`` then crashing on ``load``); this test pins the contract
+for every entry in ``ALL_OPS``.
+"""
+
+import pytest
+
+from deepspeed_tpu.ops.op_builder import ALL_OPS, get_builder
+
+
+@pytest.mark.parametrize("name", sorted(ALL_OPS))
+def test_builder_load(name):
+    builder = ALL_OPS[name]()
+    assert builder.name == name
+    if not builder.is_compatible(verbose=False):
+        # only the two host-native builders may legitimately report
+        # incompatible (missing toolchain) — and then load() must raise,
+        # not silently succeed
+        assert name in ("cpu_adam", "async_io")
+        with pytest.raises(Exception):
+            builder.load(verbose=False)
+        return
+    module = builder.load(verbose=False)
+    assert module is not None
+
+
+def test_get_builder_lookup():
+    assert get_builder("fused_adam") is ALL_OPS["fused_adam"]
+    assert get_builder("definitely_not_an_op") is None
+
+
+def test_utils_builder_flatten_roundtrip():
+    import jax.numpy as jnp
+    import numpy as np
+
+    mod = ALL_OPS["utils"]().load(verbose=False)
+    tensors = [jnp.arange(6.0).reshape(2, 3), jnp.ones((4,)), jnp.zeros((1, 1, 2))]
+    flat = mod.flatten(tensors)
+    assert flat.shape == (12,)
+    out = mod.unflatten(flat, tensors)
+    for a, b in zip(tensors, out):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
