@@ -1,0 +1,64 @@
+"""Activation-quantization runtime scope.
+
+Counterpart of the reference's activation path in
+``deepspeed/compression/basic_layer.py`` (``LinearLayer_Compress.forward``
+quantizes the INPUT of each compressed linear when
+``activation_quantization`` is enabled via ``compress.py:100``).
+
+With functional models there is no nn.Module boundary to wrap, so the
+transform is delivered through a trace-time scope: ``CompressedModule.apply``
+enters :func:`activation_quantization_scope` with the active config rows, and
+model forwards call :func:`maybe_quantize` at their linear-input sites
+(``TransformerLM._layer``: ``layers/attn_input`` and ``layers/mlp_input``).
+The scope is read while JAX traces the forward, so the quantization is baked
+into the compiled program — zero overhead when disabled.
+
+Only dynamic (per-call scale) quantization is implemented — the natural fit
+for a traced program; the reference's static-range calibration would need
+threaded calibration state.
+"""
+
+from __future__ import annotations
+
+import re
+from contextlib import contextmanager
+from typing import List, Tuple
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.compression.basic_layer import quantize_activation
+
+# (bits, site_patterns) rows active for the current trace; module-level is
+# correct here because entry/exit bracket a single (traced) forward call.
+_ACTIVE: List[Tuple[int, List[str]]] = []
+
+
+def _site_matches(site: str, patterns: List[str]) -> bool:
+    for pat in patterns:
+        if re.match("^" + re.escape(pat).replace(r"\*", ".*") + "$", site):
+            return True
+    return False
+
+
+@contextmanager
+def activation_quantization_scope(rows: List[Tuple[int, List[str]]]):
+    """``rows``: (bits, module_patterns) for each active config group."""
+    _ACTIVE.extend(rows)
+    try:
+        yield
+    finally:
+        del _ACTIVE[len(_ACTIVE) - len(rows):]
+
+
+def maybe_quantize(x: jnp.ndarray, site: str) -> jnp.ndarray:
+    """Fake-quantize ``x`` (straight-through gradient) if any active row's
+    patterns match ``site``; identity otherwise. Model forwards call this at
+    their linear-input sites."""
+    for bits, patterns in _ACTIVE:
+        if _site_matches(site, patterns):
+            return quantize_activation(x, bits=bits)
+    return x
+
+
+def is_active() -> bool:
+    return bool(_ACTIVE)

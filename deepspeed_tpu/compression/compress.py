@@ -48,7 +48,14 @@ def _method_specs(compression_config: Dict) -> List[Tuple[str, Dict, List[str]]]
     (method, params, module_patterns) rows. ``schedule_offset``(+``_end``)
     ride along in params — the staging the compression scheduler drives."""
     rows = []
-    for method in (WEIGHT_QUANTIZATION, SPARSE_PRUNING, ROW_PRUNING, HEAD_PRUNING, CHANNEL_PRUNING):
+    for method in (
+        WEIGHT_QUANTIZATION,
+        ACTIVATION_QUANTIZATION,
+        SPARSE_PRUNING,
+        ROW_PRUNING,
+        HEAD_PRUNING,
+        CHANNEL_PRUNING,
+    ):
         block = compression_config.get(method)
         if not block:
             continue
@@ -125,7 +132,9 @@ class CompressedModule(DSModule):
         return [r for r in self.rows if _row_active(r[1], self._step)]
 
     def _compress(self, params):
-        rows = self.active_rows()
+        # weight-leaf transforms only; activation_quantization rows are
+        # delivered through the trace-time scope in apply()
+        rows = [r for r in self.active_rows() if r[0] != ACTIVATION_QUANTIZATION]
 
         def walk(prefix, tree):
             if isinstance(tree, dict):
@@ -146,7 +155,15 @@ class CompressedModule(DSModule):
         return self.inner.init(rng, batch)
 
     def apply(self, params, batch, *, rngs=None, train: bool = True):
-        return self.inner.apply(self._compress(params), batch, rngs=rngs, train=train)
+        from deepspeed_tpu.compression.act_quant import activation_quantization_scope
+
+        act_rows = [
+            (int(p.get("bits", p.get("start_bits", 8))), patterns)
+            for method, p, patterns in self.active_rows()
+            if method == ACTIVATION_QUANTIZATION
+        ]
+        with activation_quantization_scope(act_rows):
+            return self.inner.apply(self._compress(params), batch, rngs=rngs, train=train)
 
     def tp_partition_rules(self, params_shapes=None):
         return self.inner.tp_partition_rules(params_shapes)

@@ -44,6 +44,18 @@ def _flash_attention_available() -> bool:
         return False
 
 
+def _maybe_quantize_activation(x, site: str):
+    """QAT activation hook (compression/act_quant.py contract): identity
+    unless the enclosing forward was entered through a ``CompressedModule``
+    with an active ``activation_quantization`` group. Lazy import keeps the
+    model family free of the compression package on the hot path."""
+    from deepspeed_tpu.compression.act_quant import is_active, maybe_quantize
+
+    if not is_active():
+        return x
+    return maybe_quantize(x, site)
+
+
 def _norm(x, scale, bias, kind: str, eps: float):
     x32 = x.astype(jnp.float32)
     if kind == "rmsnorm":
@@ -355,6 +367,7 @@ class TransformerLM(DSModule):
         """Dense FFN; MoE model families override this (returns (out, aux_loss))."""
         from deepspeed_tpu.moe.experts import apply_dense_ffn
 
+        h = _maybe_quantize_activation(h, "layers/mlp_input")
         return apply_dense_ffn(p, h, self.config.activation), jnp.zeros((), jnp.float32)
 
     def _layer_params(self, params, i: int):
@@ -376,6 +389,7 @@ class TransformerLM(DSModule):
             h = _norm(x, p["attn_norm_scale"], p.get("attn_norm_bias"), cfg.norm, cfg.norm_eps)
         else:
             h = x
+        h = _maybe_quantize_activation(h, "layers/attn_input")
         q = h @ p["wq"].astype(h.dtype)
         k = h @ p["wk"].astype(h.dtype)
         v = h @ p["wv"].astype(h.dtype)
