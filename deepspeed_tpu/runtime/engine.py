@@ -443,6 +443,12 @@ class DeepSpeedEngine:
         next forward automatically; only the config bookkeeping lives here."""
         if micro_batch_size < 1:
             raise ValueError(f"micro_batch_size={micro_batch_size} must be >= 1")
+        if self._is_pipe_engine:
+            # the pipeline schedule (tick count, stage buffers) is sized at
+            # construction — mirroring set_train_batch_size's guard
+            raise NotImplementedError(
+                "set_train_micro_batch_size is unsupported on the pipeline engine"
+            )
         self._check_resize_allowed()
         gas = self.gradient_accumulation_steps()
         dp = max(1, self.data_parallel_world_size())
@@ -480,8 +486,13 @@ class DeepSpeedEngine:
     def set_data_post_process_func(self, post_process_func) -> None:
         """Install a per-batch transform on the engine dataloader
         (reference engine.py:433 — the data-efficiency post-process hook)."""
-        if self.training_dataloader is not None:
-            self.training_dataloader.post_process_func = post_process_func
+        if self.training_dataloader is None:
+            raise ValueError(
+                "set_data_post_process_func needs an engine-owned dataloader: "
+                "pass training_data to initialize() (a silently dropped hook "
+                "would train on unprocessed batches)"
+            )
+        self.training_dataloader.post_process_func = post_process_func
 
     def set_custom_curriculum_learning_schedule(self, schedule_func_dict) -> None:
         """Install custom curriculum schedule functions (reference

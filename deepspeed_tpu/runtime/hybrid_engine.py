@@ -116,6 +116,10 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
             return
         self._params = self._prefuse_params
         self._prefuse_params = None
+        # drop the fused view now: training resumes after a rollout, and a
+        # retained cache would pin a full compute-dtype param copy (plus the
+        # since-donated base tree it keys on) in HBM across training steps
+        self._fused_cache = None
         self.is_lora_fused = False
 
     def forward(self, batch):

@@ -88,29 +88,38 @@ class Quantizer:
         self.period = max(1, int(quantize_period))
         self.schedule_offset = int(schedule_offset)
         self.quantize_real_ratio = 1.0
+        self._last_bits: Optional[int] = None  # for switch-edge detection
         self.out_shardings = None  # engine sets this to the param shardings
         self._jit_cache: Dict[int, Any] = {}
 
     def state_dict(self) -> Dict[str, Any]:
-        """The anneal ratio is path-dependent state (unlike bits, which are
-        a pure function of the step) — it must ride in checkpoints."""
-        return {"quantize_real_ratio": self.quantize_real_ratio}
+        """The anneal ratio AND the last-seen bit-width are path-dependent
+        state — without _last_bits, a resume whose first step lands exactly
+        on a precision switch would miss the ratio-reset edge."""
+        return {
+            "quantize_real_ratio": self.quantize_real_ratio,
+            "last_bits": self._last_bits,
+        }
 
     def load_state_dict(self, sd: Dict[str, Any]) -> None:
         self.quantize_real_ratio = float(sd.get("quantize_real_ratio", 1.0))
+        last = sd.get("last_bits")
+        self._last_bits = int(last) if last is not None else None
 
     def current_bits(self, step: int) -> int:
-        """Bit-width at ``step``: halves each period-doubling window
-        (reference precision-switch behavior) from start toward target."""
+        """Bit-width at ``step``: drops by ONE bit per precision switch,
+        with the switch threshold doubling each time (reference
+        ``compute_quantization`` quantize.py:135 — ``start_bits -= 1``,
+        ``q_period <<= 1``): switches land at period, 2*period, 4*period, …
+        so 16→8 completes after 128×period steps."""
         if step < self.schedule_offset:
             return self.start_bits
         bits = self.start_bits
-        window = self.period
+        threshold = self.period
         s = step - self.schedule_offset
-        while bits > self.target_bits and s >= window:
-            bits = max(self.target_bits, bits // 2)
-            s -= window
-            window *= 2  # reference doubles the period per switch
+        while bits > self.target_bits and s >= threshold:
+            bits -= 1
+            threshold *= 2
         return bits
 
     def update_ratio(self) -> float:
@@ -148,11 +157,23 @@ class Quantizer:
     def quantize_tree(self, params, step: int):
         if step < self.schedule_offset:
             return params
+        ratio = self.update_ratio()
         bits = self.current_bits(step)
+        if self._last_bits is not None and bits < self._last_bits:
+            # precision switch: the reference resets the blend to pure fp16
+            # (quantize.py:137 ``quantize_real_ratio = 1.0``) so the mix
+            # re-anneals after every drop
+            self.quantize_real_ratio = 1.0
+            ratio = 1.0
+        self._last_bits = bits
+        # the mixed-fp16 blend applies while bits >= target_bits - 1
+        # (reference compute_quantization:170); with bits always >= target
+        # that's every width — kept explicit for parity with the gate
+        if not (self.q_mixed_fp16 and bits >= self.target_bits - 1):
+            ratio = 0.0
         fn = self._jit_cache.get(bits)
         if fn is None:
             fn = self._jit_cache[bits] = self._build(bits)
-        ratio = self.update_ratio()
         return fn(params, jnp.float32(ratio))
 
 

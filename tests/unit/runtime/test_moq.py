@@ -47,14 +47,34 @@ class TestQuantizeMath:
 
 
 class TestSchedule:
-    def test_bits_halve_per_doubling_window(self):
+    def test_bits_drop_one_per_doubling_threshold(self):
+        # reference compute_quantization: start_bits -= 1 per switch, with
+        # the switch threshold doubling (period, 2p, 4p, ...)
         q = Quantizer(start_bits=16, target_bits=4, quantize_period=10)
         assert q.current_bits(0) == 16
         assert q.current_bits(9) == 16
-        assert q.current_bits(10) == 8
-        assert q.current_bits(29) == 8  # next window is 20 long
-        assert q.current_bits(30) == 4
-        assert q.current_bits(10_000) == 4  # floor
+        assert q.current_bits(10) == 15
+        assert q.current_bits(19) == 15
+        assert q.current_bits(20) == 14  # threshold doubled to 20
+        assert q.current_bits(40) == 13  # then 40, 80, ...
+        assert q.current_bits(10 * 2**11) == 4
+        assert q.current_bits(10_000_000) == 4  # floor
+
+    def test_ratio_resets_at_precision_switch(self):
+        # reference quantize.py:137: quantize_real_ratio = 1.0 on a switch,
+        # so the fp16 blend re-anneals after every bit drop
+        q = Quantizer(
+            q_mixed_fp16=True, q_change_ratio=0.25,
+            start_bits=8, target_bits=4, quantize_period=3,
+        )
+        params = {"w": jnp.asarray(np.random.RandomState(0).randn(4, 4), jnp.bfloat16)}
+        for step in range(3):
+            q.quantize_tree(params, step)
+        assert q.quantize_real_ratio == pytest.approx(0.25)
+        q.quantize_tree(params, 3)  # bits 8 -> 7: reset to 1.0
+        assert q.quantize_real_ratio == 1.0
+        q.quantize_tree(params, 4)
+        assert q.quantize_real_ratio == pytest.approx(0.75)
 
     def test_mixed_ratio_anneals(self):
         q = Quantizer(q_mixed_fp16=True, q_change_ratio=0.25)
