@@ -14,6 +14,15 @@ from deepspeed_tpu.checkpoint.reference_ingest import (
     merge_reference_zero_fp32,
     read_universal_dir,
 )
+from deepspeed_tpu.checkpoint.reshape_3d import (
+    Model3DDescriptor,
+    describe_checkpoint,
+    export_megatron_checkpoint,
+    load_megatron_checkpoint,
+    read_reference_layout,
+    reshape_checkpoint_3d,
+    write_reference_layout,
+)
 from deepspeed_tpu.checkpoint.reshape_utils import (
     ReshapeMeg2D,
     merge_tp_slices,

@@ -73,9 +73,18 @@ def _torch_load(path: str):
     return torch.load(path, map_location="cpu", weights_only=False)
 
 
-def _to_numpy(t) -> np.ndarray:
+def _to_numpy(t, preserve_bf16: bool = False) -> np.ndarray:
+    """Torch tensor → numpy. Default: promote to fp32 (lossless for bf16;
+    the merge paths want one dtype). ``preserve_bf16`` keeps bf16 as numpy's
+    extension dtype so dtype-preserving paths (reshape_3d) round-trip."""
     if hasattr(t, "detach"):
-        return t.detach().cpu().float().numpy()
+        t = t.detach().cpu()
+        if preserve_bf16 and str(t.dtype) == "torch.bfloat16":
+            import jax.numpy as jnp
+            import torch
+
+            return np.asarray(jnp.asarray(t.to(torch.float32).numpy()).astype(jnp.bfloat16))
+        return t.float().numpy()
     return np.asarray(t)
 
 

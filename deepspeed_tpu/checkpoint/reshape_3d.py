@@ -1,0 +1,659 @@
+"""General 3D checkpoint re-layout: (tp, pp, dp) → (tp', pp', dp').
+
+Counterpart of the reference's ``deepspeed/checkpoint/reshape_meg_2d.py``
+(``reshape_meg_2d_parallel``), ``reshape_3d_utils.py`` (``model_3d_desc``)
+and ``zero_checkpoint.py`` (``ZeROCheckpoint`` merge): re-laying a
+Megatron-family checkpoint — per-layer ``layer_XX-model_YY-model_states.pt``
+files, per-(pp,tp)-rank ``mp_rank_XX_model_states.pt`` files and per-dp-rank
+``zero_pp_rank_D_mp_rank_XX_optim_states.pt`` ZeRO shards — onto a different
+parallel topology.
+
+Strategy differs from the reference deliberately. The reference remaps and
+merges FILES, so it can only contract (new degree ≤ old, divisibility
+required). Here every re-layout goes through a CANONICAL full-tensor form
+(layer → {param → full array}, plus full fp32 masters and Adam moments) and
+re-emits the target file family from it — the on-disk analogue of GSPMD's
+global-array resharding, correct for arbitrary targets including expansion.
+
+TP split/merge axes come from the model's ``tp_partition_rules`` — the same
+specs that drive GSPMD shardings drive checkpoint slicing — recorded in the
+``mp_rank`` files under ``tp_axes`` on export and recovered from there on
+read (falling back to reference-style name heuristics for foreign files).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.checkpoint.reference_ingest import (
+    _resolve_tag_dir,
+    _to_numpy,
+    _torch_load,
+)
+from deepspeed_tpu.checkpoint.reshape_utils import (
+    merge_tp_slices,
+    partition_data,
+    split_tp_slices,
+)
+from deepspeed_tpu.utils.logging import log_dist
+
+
+def _key_order(key: str) -> int:
+    """Layer keys sort NUMERICALLY ('02' < '10' < '100'); a string sort
+    would permute stacks past 99 layers. SHARED_KEY ('00') stays first."""
+    return int(key)
+
+LAYER_RE = re.compile(r"layer_(\d+)-model_(\d+)-model_states\.pt$")
+MP_RE = re.compile(r"mp_rank_(\d+)_model_states\.pt$")
+ZERO_RE = re.compile(r"(?:bf16_)?zero_pp_rank_(\d+)_mp_rank_(\d+)_optim_states\.pt$")
+
+# Foreign (no recorded tp_axes) checkpoints: reference
+# deepspeed_checkpoint.py LAYER_CONCAT_DIM — row-parallel weights merge on
+# the input-features axis; everything else defaults to axis 0 unless the
+# shards are identical (replicated).
+_ROW_PARALLEL_HINTS = ("wo", "w_out", "self_attention.dense.weight", "mlp.dense_4h_to_h.weight")
+
+LAYERS_PREFIX = "layers/"
+SHARED_KEY = "00"  # non-layer params (embeddings, final norm, head) live here
+
+
+class Model3DDescriptor:
+    """(tp, pp, dp) of a checkpoint directory (reference ``model_3d_desc``)."""
+
+    def __init__(self, tp_degree: int = 1, pp_degree: int = 1, dp_degree: int = 1):
+        self.tp_degree = int(tp_degree)
+        self.pp_degree = int(pp_degree)
+        self.dp_degree = int(dp_degree)
+
+    def world_size(self) -> int:
+        return self.tp_degree * self.pp_degree * self.dp_degree
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, Model3DDescriptor)
+            and (self.tp_degree, self.pp_degree, self.dp_degree)
+            == (other.tp_degree, other.pp_degree, other.dp_degree)
+        )
+
+    def __repr__(self) -> str:
+        return f"Model3DDescriptor(tp={self.tp_degree}, pp={self.pp_degree}, dp={self.dp_degree})"
+
+
+def describe_checkpoint(path: str) -> Model3DDescriptor:
+    """Infer (tp, pp, dp) from the file family (reference
+    ``get_model_3d_descriptor``)."""
+    mp_files = [f for f in os.listdir(path) if MP_RE.search(f)]
+    layer_files = [f for f in os.listdir(path) if LAYER_RE.search(f)]
+    zero_files = [f for f in os.listdir(path) if ZERO_RE.search(f)]
+    if layer_files:
+        first_key = sorted(LAYER_RE.search(f).group(1) for f in layer_files)[0]
+        tp = len([f for f in layer_files if LAYER_RE.search(f).group(1) == first_key])
+        pp = max(1, len(mp_files) // tp)
+    else:
+        tp = max(1, len(mp_files))
+        pp = 1
+    dp = max(1, len(zero_files) // max(1, tp * pp)) if zero_files else 1
+    return Model3DDescriptor(tp_degree=tp, pp_degree=pp, dp_degree=dp)
+
+
+# --------------------------------------------------------------------------
+# engine state → canonical form
+
+
+def _spec_axis(spec) -> Optional[int]:
+    """Index of the 'model' mesh axis in a PartitionSpec (None: replicated)."""
+    if spec is None:
+        return None
+    for i, part in enumerate(tuple(spec)):
+        names = part if isinstance(part, (tuple, list)) else (part,)
+        if "model" in [n for n in names if n is not None]:
+            return i
+    return None
+
+
+def engine_canonical_state(engine) -> Dict[str, Any]:
+    """Read ``engine``'s training state into the canonical full-tensor form:
+    ``layers[key][name]`` (module dtype), ``fp32``/``exp_avg``/``exp_avg_sq``
+    parallel structures, per-param TP axes, and run counters."""
+    import jax
+
+    from deepspeed_tpu.utils.tensor_fragment import _flatten_with_paths
+
+    if not getattr(engine, "_initialized", False):
+        raise RuntimeError("cannot export before the engine state is initialized")
+
+    master_flat = {
+        name: np.asarray(jax.device_get(v), np.float32)
+        for name, v in _flatten_with_paths(engine.get_master_params()).items()
+    }
+    module_flat = {
+        name: np.asarray(v) for name, v in engine.consolidated_16bit_state_dict().items()
+    }
+
+    opt = engine._opt_state
+    moments: Dict[str, Dict[str, np.ndarray]] = {}
+    opt_step = 0
+    if opt is not None and hasattr(opt, "exp_avg") and hasattr(opt, "exp_avg_sq"):
+        for kind in ("exp_avg", "exp_avg_sq"):
+            moments[kind] = {
+                name: np.asarray(jax.device_get(v), np.float32)
+                for name, v in _flatten_with_paths(getattr(opt, kind)).items()
+            }
+        opt_step = int(jax.device_get(opt.step)) if hasattr(opt, "step") else 0
+
+    specs = engine.module.tp_partition_rules(engine.get_master_params())
+    spec_flat = _flatten_with_paths(specs) if specs is not None else {}
+
+    def split_layers(flat: Dict[str, np.ndarray], stacked_axis_shift: bool):
+        layers: "OrderedDict[str, OrderedDict[str, np.ndarray]]" = OrderedDict()
+        layers[SHARED_KEY] = OrderedDict()
+        n_layers = 0
+        for name, arr in flat.items():
+            if name.startswith(LAYERS_PREFIX):
+                n_layers = max(n_layers, arr.shape[0])
+        for i in range(n_layers):
+            layers[f"{i + 1:02d}"] = OrderedDict()
+        for name, arr in flat.items():
+            if name.startswith(LAYERS_PREFIX):
+                sub = name[len(LAYERS_PREFIX):]
+                for i in range(arr.shape[0]):
+                    layers[f"{i + 1:02d}"][sub] = np.ascontiguousarray(arr[i])
+            else:
+                layers[SHARED_KEY][name] = arr
+        return layers
+
+    canon = {
+        "layers": split_layers(module_flat, True),
+        "fp32": split_layers(master_flat, True),
+        "global": {
+            "iteration": int(engine.global_steps),
+            "global_samples": int(engine.global_samples),
+            "micro_steps": int(engine.micro_steps),
+            "skipped_steps": int(engine.skipped_steps),
+            "opt_step": opt_step,
+            "lr_scheduler": engine.lr_scheduler.state_dict()
+            if engine.lr_scheduler is not None
+            else None,
+            "ds_version": "0.10.2+tpu",
+        },
+    }
+    for kind in ("exp_avg", "exp_avg_sq"):
+        canon[kind] = split_layers(moments[kind], True) if kind in moments else None
+
+    # per-(layer, name) TP split axis, in PER-LAYER coordinates (the stacked
+    # [L, ...] leading dim is dropped for layer params)
+    tp_axes: Dict[str, Dict[str, Optional[int]]] = {}
+    for key, group in canon["layers"].items():
+        tp_axes[key] = {}
+        for name in group:
+            full_name = name if key == SHARED_KEY else LAYERS_PREFIX + name
+            axis = _spec_axis(spec_flat.get(full_name))
+            if axis is not None and key != SHARED_KEY:
+                axis -= 1  # un-stack: spec axis 0 is the scanned layer dim
+            tp_axes[key][name] = axis
+    canon["tp_axes"] = tp_axes
+    return canon
+
+
+# --------------------------------------------------------------------------
+# canonical form → reference file family
+
+
+def _shard(arr: np.ndarray, axis: Optional[int], tp: int, t: int) -> np.ndarray:
+    if axis is None or tp == 1:
+        return arr
+    return split_tp_slices(arr, tp, axis)[t]
+
+
+def write_reference_layout(
+    canon: Dict[str, Any], path: str, tp: int = 1, pp: int = 1, dp: int = 1
+) -> str:
+    """Emit the canonical state as the reference's Megatron file family."""
+    import torch
+
+    os.makedirs(path, exist_ok=True)
+    layer_keys = list(canon["layers"].keys())
+    # Effective axes FOR THIS tp degree: a dim not divisible by tp stays
+    # replicated, and the recorded metadata must say so — the reader merging
+    # on a nominal axis would concatenate identical replicas. The NOMINAL
+    # axes are recorded alongside so a later reshape to a compatible tp can
+    # still slice (a tp=1 layout would otherwise erase every axis).
+    nominal_axes = canon["tp_axes"]
+    tp_axes = {
+        key: {
+            name: (
+                axis
+                if axis is not None
+                and tp > 1
+                and canon["layers"][key][name].shape[axis] % tp == 0
+                else None
+            )
+            for name, axis in nominal_axes[key].items()
+        }
+        for key in nominal_axes
+    }
+
+    def to_torch(v: np.ndarray):
+        if v.dtype.name == "bfloat16":
+            return torch.from_numpy(np.ascontiguousarray(v.astype(np.float32))).to(torch.bfloat16)
+        return torch.from_numpy(np.ascontiguousarray(v))
+
+    for key in layer_keys:
+        for t in range(tp):
+            sd = {
+                name: to_torch(_shard(arr, tp_axes[key].get(name), tp, t))
+                for name, arr in canon["layers"][key].items()
+            }
+            torch.save(sd, os.path.join(path, f"layer_{key}-model_{t:02d}-model_states.pt"))
+
+    stage_keys = [
+        [layer_keys[i] for i in idxs] for idxs in partition_data(pp, len(layer_keys))
+    ]
+    has_zero = canon.get("fp32") is not None
+
+    for p in range(pp):
+        for t in range(tp):
+            rank = p * tp + t
+            # flat-group order for this rank's ZeRO shards: layer key order,
+            # then insertion (name) order — recorded in param_shapes so the
+            # reader re-splits without guessing
+            shapes: "OrderedDict[str, Any]" = OrderedDict()
+            fp32_parts, m_parts, v_parts = [], [], []
+            for key in stage_keys[p]:
+                for name, arr in canon["layers"][key].items():
+                    axis = tp_axes[key].get(name)
+                    shard_shape = _shard(arr, axis, tp, t).shape
+                    shapes[f"{key}:{name}"] = torch.Size(shard_shape)
+                    if has_zero:
+                        fp32_parts.append(
+                            _shard(canon["fp32"][key][name], axis, tp, t).ravel()
+                        )
+                        if canon.get("exp_avg") is not None:
+                            m_parts.append(
+                                _shard(canon["exp_avg"][key][name], axis, tp, t).ravel()
+                            )
+                            v_parts.append(
+                                _shard(canon["exp_avg_sq"][key][name], axis, tp, t).ravel()
+                            )
+            torch.save(
+                {
+                    "iteration": canon["global"].get("iteration", 0),
+                    "global_steps": canon["global"].get("iteration", 0),
+                    "args": None,
+                    "ds_version": canon["global"].get("ds_version", "0.10.2+tpu"),
+                    "tp_degree": tp,
+                    "pp_degree": pp,
+                    "dp_degree": dp,
+                    "pp_layer_keys": stage_keys[p],
+                    "tp_axes": nominal_axes,
+                    "tp_axes_effective": tp_axes,
+                    "param_shapes": [shapes],
+                    "global_state": canon["global"],
+                },
+                os.path.join(path, f"mp_rank_{rank:02d}_model_states.pt"),
+            )
+            if not has_zero:
+                continue
+
+            def dp_split(parts: List[np.ndarray]) -> List[np.ndarray]:
+                flat = (
+                    np.concatenate(parts).astype(np.float32)
+                    if parts
+                    else np.zeros(0, np.float32)
+                )
+                flat = np.pad(flat, (0, (-flat.size) % dp))
+                return np.split(flat, dp)
+
+            fp32_dp = dp_split(fp32_parts)
+            m_dp = dp_split(m_parts) if m_parts else None
+            v_dp = dp_split(v_parts) if v_parts else None
+            for d in range(dp):
+                osd: Dict[str, Any] = {
+                    "zero_stage": 1,
+                    "partition_count": dp,
+                    "single_partition_of_fp32_groups": [to_torch(fp32_dp[d])],
+                    "ds_version": canon["global"].get("ds_version", "0.10.2+tpu"),
+                }
+                if m_dp is not None:
+                    osd["base_optimizer_state"] = {
+                        "state": [
+                            {
+                                "step": canon["global"].get("opt_step", 0),
+                                "exp_avg": to_torch(m_dp[d]),
+                                "exp_avg_sq": to_torch(v_dp[d]),
+                            }
+                        ]
+                    }
+                torch.save(
+                    {"optimizer_state_dict": osd},
+                    os.path.join(path, f"zero_pp_rank_{d}_mp_rank_{rank:02d}_optim_states.pt"),
+                )
+    return path
+
+
+# --------------------------------------------------------------------------
+# reference file family → canonical form
+
+
+def _heuristic_axis(name: str, shards: List[np.ndarray]) -> Optional[int]:
+    if len(shards) == 1 or shards[0].ndim == 0:
+        return None
+    if all(s.shape == shards[0].shape and np.array_equal(s, shards[0]) for s in shards[1:]):
+        return None  # replicated
+    short = name.split("/")[-1]
+    if short in _ROW_PARALLEL_HINTS or any(name.endswith(h) for h in _ROW_PARALLEL_HINTS):
+        return min(1, shards[0].ndim - 1)
+    return 0
+
+
+def read_reference_layout(path: str) -> Dict[str, Any]:
+    """Read a Megatron-family checkpoint directory into canonical form."""
+
+    def load(p):
+        return _torch_load(p)
+
+    def to_np(t) -> np.ndarray:
+        return _to_numpy(t, preserve_bf16=True)
+
+    desc = describe_checkpoint(path)
+    tp, pp, dp = desc.tp_degree, desc.pp_degree, desc.dp_degree
+    mp_files = sorted(
+        (f for f in os.listdir(path) if MP_RE.search(f)),
+        key=lambda f: int(MP_RE.search(f).group(1)),
+    )
+    if not mp_files:
+        raise FileNotFoundError(f"no mp_rank_*_model_states.pt under {path}")
+    mp0 = load(os.path.join(path, mp_files[0]))
+    # nominal axes survive re-splitting at any target tp; the EFFECTIVE axes
+    # are what this layout actually sliced with (non-divisible dims stay
+    # replicated) and are what merging must use
+    nominal_axes = mp0.get("tp_axes")
+    merge_axes = mp0.get("tp_axes_effective") or nominal_axes
+    if "tp_degree" in mp0:
+        tp, pp, dp = int(mp0["tp_degree"]), int(mp0["pp_degree"]), int(mp0["dp_degree"])
+
+    # ---- layer files → full tensors --------------------------------------
+    layer_files = [f for f in os.listdir(path) if LAYER_RE.search(f)]
+    layers: "OrderedDict[str, OrderedDict[str, np.ndarray]]" = OrderedDict()
+    tp_axes: Dict[str, Dict[str, Optional[int]]] = {}
+    eff_axes: Dict[str, Dict[str, Optional[int]]] = {}
+    if layer_files:
+        keys = sorted({LAYER_RE.search(f).group(1) for f in layer_files}, key=_key_order)
+        for key in keys:
+            per_tp = []
+            for t in range(tp):
+                f = os.path.join(path, f"layer_{key}-model_{t:02d}-model_states.pt")
+                per_tp.append({k: to_np(v) for k, v in load(f).items()})
+            layers[key] = OrderedDict()
+            tp_axes[key] = {}
+            eff_axes[key] = {}
+            for name in per_tp[0]:
+                shards = [m[name] for m in per_tp]
+                if merge_axes is not None:
+                    axis = merge_axes.get(key, {}).get(name)
+                else:
+                    axis = _heuristic_axis(name, shards)
+                eff_axes[key][name] = axis
+                tp_axes[key][name] = (
+                    nominal_axes.get(key, {}).get(name) if nominal_axes is not None else axis
+                )
+                layers[key][name] = (
+                    shards[0] if axis is None else merge_tp_slices(shards, axis)
+                )
+    else:
+        # flat (non-pipeline) checkpoints: whole module as the shared layer
+        per_tp = [load(os.path.join(path, f)) for f in mp_files]
+        modules = [{k: to_np(v) for k, v in (s.get("module") or {}).items()} for s in per_tp]
+        layers[SHARED_KEY] = OrderedDict()
+        tp_axes[SHARED_KEY] = {}
+        eff_axes[SHARED_KEY] = {}
+        for name in modules[0]:
+            shards = [m[name] for m in modules]
+            if merge_axes is not None:
+                axis = merge_axes.get(SHARED_KEY, {}).get(name)
+            else:
+                axis = _heuristic_axis(name, shards)
+            eff_axes[SHARED_KEY][name] = axis
+            tp_axes[SHARED_KEY][name] = (
+                nominal_axes.get(SHARED_KEY, {}).get(name)
+                if nominal_axes is not None
+                else axis
+            )
+            layers[SHARED_KEY][name] = (
+                shards[0] if axis is None else merge_tp_slices(shards, axis)
+            )
+
+    canon: Dict[str, Any] = {
+        "layers": layers,
+        "tp_axes": tp_axes,
+        "fp32": None,
+        "exp_avg": None,
+        "exp_avg_sq": None,
+        "global": dict(
+            mp0.get("global_state")
+            or {"iteration": int(mp0.get("iteration") or mp0.get("global_steps") or 0)}
+        ),
+    }
+
+    # ---- zero shards → full fp32/moments ---------------------------------
+    zero_any = [f for f in os.listdir(path) if ZERO_RE.search(f)]
+    if not zero_any:
+        return canon
+    fp32: "OrderedDict[str, OrderedDict[str, np.ndarray]]" = OrderedDict(
+        (k, OrderedDict()) for k in layers
+    )
+    exp_avg = OrderedDict((k, OrderedDict()) for k in layers)
+    exp_avg_sq = OrderedDict((k, OrderedDict()) for k in layers)
+    have_moments = False
+    # shard slices per (key, name): one entry per contributing tp rank
+    slices: Dict[Any, Dict[int, Dict[str, np.ndarray]]] = {}
+    for rank_file in mp_files:
+        rank = int(MP_RE.search(rank_file).group(1))
+        sd = load(os.path.join(path, rank_file))
+        shapes_groups = sd.get("param_shapes")
+        if shapes_groups is None:
+            raise ValueError(f"{rank_file} records no param_shapes; cannot split ZeRO shards")
+        t = rank % tp
+        zfiles = sorted(
+            glob.glob(os.path.join(path, f"*zero_pp_rank_*_mp_rank_{rank:02d}_optim_states.pt")),
+            key=lambda p: int(ZERO_RE.search(p).group(1)),
+        )
+        zstates = [load(f)["optimizer_state_dict"] for f in zfiles]
+        for g, shapes in enumerate(shapes_groups):
+            flat = np.concatenate(
+                [to_np(z["single_partition_of_fp32_groups"][g]).ravel() for z in zstates]
+            )
+            flat_m = flat_v = None
+            if zstates and "base_optimizer_state" in zstates[0]:
+                have_moments = True
+                flat_m = np.concatenate(
+                    [to_np(z["base_optimizer_state"]["state"][g]["exp_avg"]).ravel() for z in zstates]
+                )
+                flat_v = np.concatenate(
+                    [
+                        to_np(z["base_optimizer_state"]["state"][g]["exp_avg_sq"]).ravel()
+                        for z in zstates
+                    ]
+                )
+            offset = 0
+            for qualified, shape in shapes.items():
+                key, name = qualified.split(":", 1) if ":" in qualified else (SHARED_KEY, qualified)
+                n = int(np.prod(shape)) if len(shape) else 1
+                rec = slices.setdefault((key, name), {})
+                entry = {"fp32": flat[offset : offset + n].reshape(tuple(shape))}
+                if flat_m is not None:
+                    entry["exp_avg"] = flat_m[offset : offset + n].reshape(tuple(shape))
+                    entry["exp_avg_sq"] = flat_v[offset : offset + n].reshape(tuple(shape))
+                rec[t] = entry
+                offset += n
+    for (key, name), per_tp_slices in slices.items():
+        axis = eff_axes.get(key, {}).get(name)
+        ordered = [per_tp_slices[t] for t in sorted(per_tp_slices)]
+        for kind, target in (("fp32", fp32), ("exp_avg", exp_avg), ("exp_avg_sq", exp_avg_sq)):
+            if kind not in ordered[0]:
+                continue
+            shards = [o[kind] for o in ordered]
+            target.setdefault(key, OrderedDict())[name] = (
+                shards[0] if axis is None or len(shards) == 1 else merge_tp_slices(shards, axis)
+            )
+    canon["fp32"] = fp32
+    if have_moments:
+        canon["exp_avg"] = exp_avg
+        canon["exp_avg_sq"] = exp_avg_sq
+    return canon
+
+
+# --------------------------------------------------------------------------
+# public entry points
+
+
+def export_megatron_checkpoint(
+    engine, save_dir: str, tp: int = 1, pp: int = 1, dp: Optional[int] = None, tag: Optional[str] = None
+) -> str:
+    """Write ``engine``'s state as a reference Megatron-family checkpoint at
+    the requested (tp, pp, dp) layout. Returns the tag directory."""
+    from deepspeed_tpu import comm as dist
+
+    if tag is None:
+        tag = f"global_step{engine.global_steps}"
+    if dp is None:
+        dp = max(1, int(engine.data_parallel_world_size()))
+    # canonical consolidation runs on EVERY process (device_get of
+    # dp-sharded global arrays needs all participants); file writes are
+    # rank-0-gated with a closing barrier, like reference_export.py:76
+    canon = engine_canonical_state(engine)
+    path = os.path.join(save_dir, tag)
+    if dist.get_rank() == 0:
+        write_reference_layout(canon, path, tp=tp, pp=pp, dp=dp)
+        with open(os.path.join(save_dir, "latest"), "w") as f:
+            f.write(tag)
+    dist.barrier(name="export_megatron_checkpoint")
+    log_dist(f"exported megatron-layout checkpoint: {path} (tp={tp} pp={pp} dp={dp})", ranks=[0])
+    return path
+
+
+def reshape_checkpoint_3d(
+    src_dir: str,
+    dst_dir: str,
+    tp: int = 1,
+    pp: int = 1,
+    dp: int = 1,
+    tag: Optional[str] = None,
+) -> str:
+    """Re-layout ``src_dir`` (a tag dir, or a dir with a ``latest`` pointer)
+    onto (tp, pp, dp), writing the same file family under ``dst_dir``."""
+    path = _resolve_tag_dir(src_dir, tag)
+    if path != src_dir and tag is None:
+        tag = os.path.basename(path)
+    src_desc = describe_checkpoint(path)
+    canon = read_reference_layout(path)
+    out = dst_dir if tag is None else os.path.join(dst_dir, tag)
+    write_reference_layout(canon, out, tp=tp, pp=pp, dp=dp)
+    if tag is not None:
+        with open(os.path.join(dst_dir, "latest"), "w") as f:
+            f.write(tag)
+    log_dist(
+        f"reshaped checkpoint {src_desc} -> {Model3DDescriptor(tp, pp, dp)}: {out}",
+        ranks=[0],
+    )
+    return out
+
+
+def load_megatron_checkpoint(
+    engine, load_dir: str, tag: Optional[str] = None, load_optimizer_states: bool = True
+):
+    """Load a Megatron-family checkpoint (any (tp, pp, dp) layout) into a
+    live engine — the resume leg of the reshape story. The engine's own mesh
+    resharding places the full tensors; the source topology is irrelevant."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.utils.tensor_fragment import _flatten_with_paths
+
+    if not getattr(engine, "_initialized", False):
+        raise RuntimeError("engine state must be initialized before load (run init_params)")
+    path = _resolve_tag_dir(load_dir, tag)
+    canon = read_reference_layout(path)
+
+    def restack(groups: Dict[str, Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
+        flat: Dict[str, np.ndarray] = dict(groups.get(SHARED_KEY, {}))
+        layer_keys = sorted((k for k in groups if k != SHARED_KEY), key=_key_order)
+        if layer_keys:
+            names = groups[layer_keys[0]].keys()
+            for name in names:
+                flat[LAYERS_PREFIX + name] = np.stack(
+                    [groups[k][name] for k in layer_keys]
+                )
+        return flat
+
+    def rebuild(template, flat: Dict[str, np.ndarray], cast=None):
+        tpl_flat = _flatten_with_paths(template)
+        missing = sorted(set(tpl_flat) - set(flat))
+        if missing:
+            raise KeyError(f"checkpoint is missing parameters: {missing[:5]} (+{len(missing) - 5 if len(missing) > 5 else 0} more)")
+
+        def walk(prefix, node):
+            if isinstance(node, dict):
+                return {
+                    k: walk(f"{prefix}/{k}" if prefix else str(k), v) for k, v in node.items()
+                }
+            if node is None:
+                return None
+            arr = flat[prefix]
+            return arr.astype(cast) if cast is not None else arr
+
+        return walk("", template)
+
+    master_tpl = engine.get_master_params()
+    module_flat = restack(canon["layers"])
+    fp32_flat = restack(canon["fp32"]) if canon.get("fp32") else None
+
+    put_p = jax.jit(lambda t: t, out_shardings=engine._param_shardings)
+    compute_dtype = jnp.bfloat16 if engine.bfloat16_enabled() else (
+        jnp.float16 if engine.fp16_enabled() else jnp.float32
+    )
+    engine._params = put_p(
+        jax.tree_util.tree_map(
+            jnp.asarray,
+            rebuild(master_tpl, module_flat, cast=compute_dtype if engine.mixed_precision else None),
+        )
+    )
+    if engine.mixed_precision:
+        put_m = jax.jit(lambda t: t, out_shardings=engine._master_shardings)
+        src = fp32_flat if fp32_flat is not None else module_flat
+        engine._master = put_m(
+            jax.tree_util.tree_map(jnp.asarray, rebuild(master_tpl, src, cast=np.float32))
+        )
+    else:
+        engine._master = engine._params
+
+    if (
+        load_optimizer_states
+        and canon.get("exp_avg")
+        and engine._opt_state is not None
+        and hasattr(engine._opt_state, "exp_avg")
+    ):
+        m_tree = rebuild(master_tpl, restack(canon["exp_avg"]), cast=np.float32)
+        v_tree = rebuild(master_tpl, restack(canon["exp_avg_sq"]), cast=np.float32)
+        new_state = engine._opt_state._replace(
+            step=jnp.asarray(canon["global"].get("opt_step", 0), jnp.int32),
+            exp_avg=jax.tree_util.tree_map(jnp.asarray, m_tree),
+            exp_avg_sq=jax.tree_util.tree_map(jnp.asarray, v_tree),
+        )
+        put_o = jax.jit(lambda t: t, out_shardings=engine._opt_shardings)
+        engine._opt_state = put_o(new_state)
+
+    g = canon["global"]
+    engine.global_steps = int(g.get("iteration", 0))
+    engine.global_samples = int(g.get("global_samples", 0))
+    engine.micro_steps = int(g.get("micro_steps", 0))
+    engine.skipped_steps = int(g.get("skipped_steps", 0))
+    if engine.lr_scheduler is not None and g.get("lr_scheduler"):
+        engine.lr_scheduler.load_state_dict(g["lr_scheduler"])
+    return path, {}

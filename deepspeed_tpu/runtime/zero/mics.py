@@ -44,13 +44,38 @@ class MiCS_Init:
         return False
 
 
-def MiCS_Optimizer(*args, **kwargs):
-    """The reference subclasses stage-3; here MiCS is a sharding layout, so
-    the standard engine path IS the MiCS optimizer once the mesh has a
-    data_outer axis. Raise with guidance instead of silently diverging."""
-    raise NotImplementedError(
-        "MiCS on TPU is configured declaratively: set "
-        "zero_optimization.mics_shard_size (or mesh.data_outer) and use "
-        "deepspeed.initialize — the engine's ZeRO partitioner emits the "
-        "group-sharded layout"
+def MiCS_Optimizer(
+    module,
+    init_optimizer=None,
+    timers=None,  # noqa: ARG001 - reference signature; engine owns timing
+    ds_config=None,
+    static_loss_scale: float = 1.0,
+    **kwargs,  # noqa: ARG001 - reference stage-3 knobs subsumed by config
+):
+    """Reference-shaped entry point (``MiCS_Optimizer`` mics.py:335,
+    subclassing the stage-3 optimizer). On TPU MiCS is a sharding layout,
+    not an optimizer subclass: this adapter builds the standard engine with
+    ``mics_shard_size`` applied — ``engine._apply_mics_mesh`` splits the
+    mesh into shard groups ('data') × replica groups ('data_outer') and the
+    ZeRO partitioner emits the group-sharded state layout. Returns the
+    engine (it IS the optimizer: ``backward``/``step``)."""
+    import deepspeed_tpu as ds
+
+    config = ds_config if isinstance(ds_config, dict) else getattr(ds_config, "_param_dict", None)
+    if config is None:
+        raise ValueError("MiCS_Optimizer requires ds_config (dict or DeepSpeedConfig)")
+    config = dict(config)
+    zero_cfg = dict(config.get("zero_optimization") or {})
+    zero_cfg.setdefault("stage", 3)
+    if zero_cfg.get("mics_shard_size", -1) <= 0:
+        logger.warning(
+            "MiCS_Optimizer without zero_optimization.mics_shard_size: "
+            "falling back to full-world ZeRO sharding"
+        )
+    config["zero_optimization"] = zero_cfg
+    if static_loss_scale and static_loss_scale != 1.0 and "fp16" not in config:
+        config["fp16"] = {"enabled": True, "loss_scale": static_loss_scale}
+    engine, _, _, _ = ds.initialize(
+        model=module, optimizer=init_optimizer, config=config, dist_init_required=False
     )
+    return engine

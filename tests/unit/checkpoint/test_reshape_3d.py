@@ -1,0 +1,213 @@
+"""General 3D checkpoint reshape (reference ``reshape_meg_2d.py`` /
+``reshape_3d_utils.py`` / ``zero_checkpoint.py``): export at one (tp, pp, dp),
+re-layout to another, resume with identical state."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+import deepspeed_tpu.parallel.mesh as mesh_mod
+from deepspeed_tpu.checkpoint import (
+    Model3DDescriptor,
+    describe_checkpoint,
+    export_megatron_checkpoint,
+    load_megatron_checkpoint,
+    read_reference_layout,
+    reshape_checkpoint_3d,
+)
+from deepspeed_tpu.models import TransformerLM
+from deepspeed_tpu.models.config import TransformerConfig
+
+
+def _make_engine(seed=0):
+    mesh_mod.reset_topology()
+    mcfg = TransformerConfig(
+        vocab_size=64,
+        hidden_size=32,
+        num_layers=4,
+        num_heads=2,
+        max_seq_len=16,
+        use_bias=False,
+        tie_embeddings=False,
+    )
+    engine, _, _, _ = ds.initialize(
+        model=TransformerLM(mcfg),
+        config={
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 1},
+            "steps_per_print": 1000,
+        },
+        dist_init_required=False,
+    )
+    return engine
+
+
+def _batch(seed=0):
+    rs = np.random.RandomState(seed)
+    toks = rs.randint(0, 64, (8, 17)).astype(np.int32)
+    return {"input_ids": toks[:, :-1], "labels": toks[:, 1:]}
+
+
+def _train(engine, batch, steps):
+    losses = []
+    for _ in range(steps):
+        loss = engine(batch)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    return losses
+
+
+def _flatten_canon(canon):
+    out = {}
+    for key, group in canon["layers"].items():
+        for name, arr in group.items():
+            out[f"layers/{key}/{name}"] = np.asarray(arr, np.float32)
+    for kind in ("fp32", "exp_avg", "exp_avg_sq"):
+        if canon.get(kind):
+            for key, group in canon[kind].items():
+                for name, arr in group.items():
+                    out[f"{kind}/{key}/{name}"] = np.asarray(arr, np.float32)
+    return out
+
+
+class TestReshape3D:
+    def test_describe_and_lossless_roundtrip(self, tmp_path):
+        """tp2×pp2×dp2 → tp1×pp4×dp1 → tp2×pp2×dp2 reproduces every tensor
+        (module, fp32 master, both Adam moments) bit-exactly."""
+        engine = _make_engine()
+        _train(engine, _batch(), 3)
+        src = str(tmp_path / "src")
+        export_megatron_checkpoint(engine, src, tp=2, pp=2, dp=2, tag="tag")
+        assert describe_checkpoint(f"{src}/tag") == Model3DDescriptor(2, 2, 2)
+
+        mid = str(tmp_path / "mid")
+        reshape_checkpoint_3d(src, mid, tp=1, pp=4, dp=1)
+        assert describe_checkpoint(f"{mid}/tag") == Model3DDescriptor(1, 4, 1)
+
+        back = str(tmp_path / "back")
+        reshape_checkpoint_3d(mid, back, tp=2, pp=2, dp=2)
+
+        a = _flatten_canon(read_reference_layout(f"{src}/tag"))
+        b = _flatten_canon(read_reference_layout(f"{back}/tag"))
+        assert sorted(a) == sorted(b)
+        for name in a:
+            np.testing.assert_array_equal(a[name], b[name], err_msg=name)
+
+    def test_resume_from_reshaped_identical_loss(self, tmp_path):
+        """VERDICT r4 acceptance: resume from the tp1×pp4 reshape of a
+        tp2×pp2 checkpoint ≡ resume from the original — identical losses."""
+        engine = _make_engine()
+        batch = _batch()
+        _train(engine, batch, 3)
+        src = str(tmp_path / "src")
+        export_megatron_checkpoint(engine, src, tp=2, pp=2, dp=2, tag="tag")
+        reshaped = str(tmp_path / "reshaped")
+        reshape_checkpoint_3d(src, reshaped, tp=1, pp=4, dp=1)
+
+        resumed_src = _make_engine()
+        resumed_src.init_params(batch)
+        load_megatron_checkpoint(resumed_src, src)
+        losses_src = _train(resumed_src, batch, 3)
+
+        resumed_re = _make_engine()
+        resumed_re.init_params(batch)
+        load_megatron_checkpoint(resumed_re, reshaped)
+        losses_re = _train(resumed_re, batch, 3)
+
+        assert resumed_re.global_steps == resumed_src.global_steps
+        assert losses_src == losses_re
+
+    def test_resume_continues_training(self, tmp_path):
+        """The reshaped resume actually CONTINUES the run: its first loss
+        matches the next loss of an uninterrupted engine."""
+        engine = _make_engine()
+        batch = _batch()
+        _train(engine, batch, 3)
+        src = str(tmp_path / "src")
+        export_megatron_checkpoint(engine, src, tp=2, pp=2, dp=1, tag="tag")
+        reshaped = str(tmp_path / "re")
+        reshape_checkpoint_3d(src, reshaped, tp=4, pp=1, dp=2)  # expansion too
+
+        uninterrupted = _train(engine, batch, 2)
+
+        resumed = _make_engine()
+        resumed.init_params(batch)
+        load_megatron_checkpoint(resumed, reshaped)
+        resumed_losses = _train(resumed, batch, 2)
+        np.testing.assert_allclose(resumed_losses, uninterrupted, rtol=2e-2)
+
+    def test_expansion_beyond_reference(self, tmp_path):
+        """The reference refuses expansion reshapes (reshape_3d_utils
+        ``can_reshape``); the canonical-form design handles them."""
+        engine = _make_engine()
+        _train(engine, _batch(), 2)
+        src = str(tmp_path / "src")
+        export_megatron_checkpoint(engine, src, tp=1, pp=1, dp=1, tag="tag")
+        wide = str(tmp_path / "wide")
+        reshape_checkpoint_3d(src, wide, tp=2, pp=4, dp=4)
+        assert describe_checkpoint(f"{wide}/tag") == Model3DDescriptor(2, 4, 4)
+        a = _flatten_canon(read_reference_layout(f"{src}/tag"))
+        b = _flatten_canon(read_reference_layout(f"{wide}/tag"))
+        for name in a:
+            np.testing.assert_array_equal(a[name], b[name], err_msg=name)
+
+
+class TestSharpEdges:
+    def _synthetic_canon(self, n_layers=120, odd_dim=False):
+        from collections import OrderedDict
+
+        rs = np.random.RandomState(0)
+        layers = OrderedDict()
+        tp_axes = {}
+        layers["00"] = OrderedDict(
+            {"embed/tokens": rs.randn(16, 8).astype(np.float32)}
+        )
+        tp_axes["00"] = {"embed/tokens": 0}
+        for i in range(n_layers):
+            key = f"{i + 1:02d}"
+            dim = 3 if odd_dim else 4
+            layers[key] = OrderedDict(
+                # stamp the layer index into the tensor so a permuted
+                # restack is detectable
+                {"wq": np.full((dim, 4), float(i), np.float32)}
+            )
+            tp_axes[key] = {"wq": 0}
+        return {
+            "layers": layers,
+            "tp_axes": tp_axes,
+            "fp32": None,
+            "exp_avg": None,
+            "exp_avg_sq": None,
+            "global": {"iteration": 7},
+        }
+
+    def test_layer_order_past_99(self, tmp_path):
+        """String-sorted keys would order '100' before '11'; layer identity
+        must survive a 120-layer write/read."""
+        from deepspeed_tpu.checkpoint import read_reference_layout, write_reference_layout
+
+        canon = self._synthetic_canon(n_layers=120)
+        write_reference_layout(canon, str(tmp_path / "c"), tp=2, pp=4, dp=1)
+        back = read_reference_layout(str(tmp_path / "c"))
+        keys = [k for k in back["layers"] if k != "00"]
+        assert len(keys) == 120
+        for i, key in enumerate(sorted(keys, key=int)):
+            assert float(back["layers"][key]["wq"][0, 0]) == float(i), key
+
+    def test_non_divisible_tp_dim_stays_replicated(self, tmp_path):
+        """A 'model'-axis dim not divisible by tp is stored replicated and
+        the recorded effective axis says so — the reader must NOT
+        concatenate the replicas (round-4 review finding)."""
+        from deepspeed_tpu.checkpoint import read_reference_layout, write_reference_layout
+
+        canon = self._synthetic_canon(n_layers=4, odd_dim=True)  # dim 3, tp 2
+        write_reference_layout(canon, str(tmp_path / "c"), tp=2, pp=1, dp=1)
+        back = read_reference_layout(str(tmp_path / "c"))
+        assert back["layers"]["01"]["wq"].shape == (3, 4)
+        # nominal axis survives for future re-splits at a compatible tp
+        assert back["tp_axes"]["01"]["wq"] == 0
