@@ -1,0 +1,23 @@
+#!/bin/sh
+# Fast test tier — target <10 min on the 1-core harness box (the full
+# 650+-test suite on the 8-device virtual CPU mesh runs for hours there).
+# Covers the core surface: engine + config, the whole ZeRO stack
+# (1/2/3/offload/zero++), mesh/groups, collectives, op-builder registry,
+# MoQ, and compression. Run the FULL suite (python -m pytest tests/ -q)
+# before shipping cross-cutting changes; this tier is the per-commit loop.
+# Measured 2026-07-31: ~5 min, 195 tests.
+cd "$(dirname "$0")/.." || exit 1
+exec python -m pytest -q \
+  tests/unit/runtime/test_engine.py \
+  tests/unit/runtime/test_config.py \
+  tests/unit/runtime/test_lr_schedules.py \
+  tests/unit/runtime/test_loss_scaler.py \
+  tests/unit/runtime/test_runtime_utils.py \
+  tests/unit/runtime/test_moq.py \
+  tests/unit/runtime/zero \
+  tests/unit/ops/test_op_builder.py \
+  tests/unit/parallel/test_mesh.py \
+  tests/unit/utils/test_groups.py \
+  tests/unit/comm/test_collectives.py \
+  tests/unit/compression/test_compression.py \
+  "$@"
