@@ -95,13 +95,21 @@ class InferenceEngine:
         # checkpoint handed to init_inference (reference engine.py:406):
         # a path string — engine-format dir, or an mp-checkpoint manifest
         ckpt = self._config.checkpoint
-        if isinstance(ckpt, str):
+        if isinstance(ckpt, str) and ckpt.endswith(".json") and not self._is_mp_manifest(ckpt):
+            self._load_sd_checkpoint(ckpt)
+        elif isinstance(ckpt, str):
             self._load_checkpoint(ckpt)
+        elif isinstance(ckpt, dict):
+            # the reference's SD-loader descriptor form (engine.py:406 →
+            # SDLoaderFactory.get_sd_loader_json): a dict/json naming the
+            # legacy sharded file list
+            self._load_sd_checkpoint(ckpt)
         elif ckpt is not None:
             raise NotImplementedError(
-                "init_inference checkpoint= takes a path string here (an "
-                "engine checkpoint dir or an mp-checkpoint manifest); the "
-                "reference's dict descriptor form is not supported"
+                "init_inference checkpoint= takes a path string (engine "
+                "checkpoint dir or mp-checkpoint manifest) or an SD-loader "
+                "descriptor dict/json ({'type': 'Megatron', 'checkpoints': "
+                "[...], 'version': ...})"
             )
         log_dist(
             f"InferenceEngine: dtype={self._config.dtype} "
@@ -246,6 +254,49 @@ class InferenceEngine:
             self._rng = rng
         params = self.module.init(self._rng, batch)
         self.set_params(params)
+
+    @staticmethod
+    def _is_mp_manifest(path: str) -> bool:
+        from deepspeed_tpu.inference.mp_checkpoint import is_mp_checkpoint
+
+        try:
+            return is_mp_checkpoint(path)
+        except Exception:
+            return False
+
+    def _load_sd_checkpoint(self, descriptor) -> None:
+        """Legacy sharded (SplitCheckpoint) load: merge the file list to the
+        FULL state dict (reference per-rank loads are GSPMD placements here)
+        and convert through the container policy for the descriptor's
+        model_type (default megatron)."""
+        from deepspeed_tpu.module_inject.containers import policy_for
+        from deepspeed_tpu.runtime.state_dict_factory import SDLoaderFactory
+
+        # precondition first: merging can be GBs of torch.load — don't pay
+        # for it just to discover the module can't accept the weights
+        mcfg = getattr(self.module, "config", None)
+        if mcfg is None:
+            raise ValueError(
+                "SD-loader checkpoints need an injected module with a model "
+                "config (build the model via init_inference kernel injection "
+                "or replace_transformer_layer first)"
+            )
+        if isinstance(descriptor, str):
+            import json as _json
+
+            with open(descriptor) as f:
+                descriptor = _json.load(f)
+        loader = SDLoaderFactory.get_sd_loader_json(descriptor)
+        if isinstance(loader, dict):
+            raise NotImplementedError(
+                f"pre-sharded '{loader.get('type')}' descriptors load via the "
+                "mp-checkpoint manifest path"
+            )
+        _, sd, _ = loader.load(mp_world_size=1, mp_rank=0)
+        merged = loader.get_module(sd)
+        model_type = descriptor.get("model_type", "megatron")
+        policy = policy_for(model_type)
+        self.set_params(policy.convert_weights(merged, mcfg))
 
     def _load_checkpoint(self, load_dir: str) -> None:
         from deepspeed_tpu.inference.mp_checkpoint import is_mp_checkpoint, load_mp_checkpoint

@@ -1,0 +1,233 @@
+"""Legacy sharded state-dict loading (reference
+``runtime/state_dict_factory.py`` + ``weight_quantizer.py``): Megatron
+SplitCheckpoint merge/split with optional quantize-on-load."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.state_dict_factory import (
+    AUTO_MODULE_KEY,
+    MegatronSDLoader,
+    SDLoaderFactory,
+)
+from deepspeed_tpu.runtime.weight_quantizer import WeightQuantization, dequantize_weight
+from tests.unit.inference.test_containers import _megatron_sd, _MegatronCfg
+
+QKV_OR_COL = ("attention.query_key_value", "mlp.dense_h_to_4h", "word_embeddings.weight")
+ROW = ("attention.dense.weight", "mlp.dense_4h_to_h.weight")
+
+
+def _shard_megatron_sd(sd, mp):
+    """Split a full Megatron sd into mp shard dicts (checkpoint v2.0:
+    qkv is a plain axis-0 split)."""
+    shards = [dict() for _ in range(mp)]
+    for key, value in sd.items():
+        if any(h in key for h in QKV_OR_COL):
+            parts = np.split(value, mp, axis=0)
+        elif any(h in key for h in ROW):
+            parts = np.split(value, mp, axis=1)
+        else:
+            parts = [value] * mp
+        for r in range(mp):
+            shards[r][key] = np.ascontiguousarray(parts[r])
+    return shards
+
+
+def _save_shards(tmp_path, shards, version=2.0):
+    import torch
+
+    files = []
+    for r, shard in enumerate(shards):
+        path = str(tmp_path / f"mp_rank_{r:02d}_model_states.pt")
+        torch.save(
+            {
+                "module": {k: torch.from_numpy(v) for k, v in shard.items()},
+                "checkpoint_version": version,
+                "mp_world_size": len(shards),
+            },
+            path,
+        )
+        files.append(path)
+    return files
+
+
+class TestMegatronSDLoader:
+    def test_merge_to_full(self, tmp_path):
+        full = _megatron_sd()
+        files = _save_shards(tmp_path, _shard_megatron_sd(full, 2))
+        loader = SDLoaderFactory.get_sd_loader(files, sd_type="Megatron", version=2.0)
+        path, sd, (scales, merge_count) = loader.load(mp_world_size=1, mp_rank=0)
+        assert merge_count == 2 and scales is None
+        merged = loader.get_module(sd)
+        assert sorted(merged) == sorted(full)
+        for key in full:
+            np.testing.assert_allclose(merged[key], full[key], rtol=1e-6, err_msg=key)
+
+    def test_split_further(self, tmp_path):
+        full = _megatron_sd()
+        files = _save_shards(tmp_path, _shard_megatron_sd(full, 2))
+        loader = MegatronSDLoader(files, 2.0, None)
+        # 2 files -> 4 ranks: each rank gets half of one file's shard
+        ranks = [loader.load(mp_world_size=4, mp_rank=r)[1] for r in range(4)]
+        key = "language_model.transformer.layers.0.mlp.dense_h_to_4h.weight"
+        stacked = np.concatenate([loader.get_module(r)[key] for r in ranks], axis=0)
+        np.testing.assert_allclose(stacked, full[key], rtol=1e-6)
+        row_key = "language_model.transformer.layers.0.attention.dense.weight"
+        stacked_row = np.concatenate([loader.get_module(r)[row_key] for r in ranks], axis=1)
+        np.testing.assert_allclose(stacked_row, full[row_key], rtol=1e-6)
+
+    def test_qkv_version0_interleave(self):
+        loader = MegatronSDLoader.__new__(MegatronSDLoader)
+        loader.version = 0
+        rs = np.random.RandomState(0)
+        full_q, full_k, full_v = rs.randn(3, 8, 4).astype(np.float32)
+        # v0 shard format: [(3 * np * hn), h] — each shard holds its q,k,v
+        shards = [
+            np.concatenate([full_q[i * 4 : (i + 1) * 4], full_k[i * 4 : (i + 1) * 4], full_v[i * 4 : (i + 1) * 4]])
+            for i in range(2)
+        ]
+        merged = loader.merge_query_key_value(shards, 0)
+        np.testing.assert_array_equal(merged, np.concatenate([full_q, full_k, full_v]))
+        # split inverts merge
+        for off in range(2):
+            np.testing.assert_array_equal(
+                loader.split_query_key_value(merged, 2, off, 0), shards[off]
+            )
+
+    def test_descriptor_json(self, tmp_path):
+        full = _megatron_sd()
+        files = _save_shards(tmp_path, _shard_megatron_sd(full, 2))
+        loader = SDLoaderFactory.get_sd_loader_json(
+            {"type": "Megatron", "checkpoints": files, "version": 2.0}
+        )
+        assert isinstance(loader, MegatronSDLoader)
+        # bloom/ds_model descriptors pass through untouched
+        data = SDLoaderFactory.get_sd_loader_json(
+            {"type": "bloom", "checkpoints": files, "version": 1}
+        )
+        assert isinstance(data, dict)
+
+    def test_mp_world_size_mismatch_asserts(self, tmp_path):
+        files = _save_shards(tmp_path, _shard_megatron_sd(_megatron_sd(), 2))
+        with pytest.raises(AssertionError, match="mp_world_size"):
+            MegatronSDLoader(files[:1], 2.0, None)
+
+
+class TestQuantizeOnLoad:
+    def test_merge_quantized_close_to_original(self, tmp_path):
+        full = _megatron_sd()
+        files = _save_shards(tmp_path, _shard_megatron_sd(full, 2))
+        loader = MegatronSDLoader(files, 2.0, None)
+        _, sd, (scales, _) = loader.load(
+            mp_world_size=1, mp_rank=0, quantize=True, quantize_bits=8, quantize_groups=4
+        )
+        merged = loader.get_module(sd)
+        key = "language_model.transformer.layers.0.attention.query_key_value.weight"
+        assert merged[key].dtype == np.int8
+        assert scales is not None and scales.ndim >= 2
+        # norms and biases stay exact
+        np.testing.assert_array_equal(
+            merged["language_model.transformer.final_layernorm.weight"],
+            full["language_model.transformer.final_layernorm.weight"],
+        )
+
+    def test_quantize_dequantize_roundtrip(self):
+        rs = np.random.RandomState(0)
+        w = rs.randn(16, 8).astype(np.float32)
+        wq = WeightQuantization()
+        q, scale = wq.quantize_data(w, quantize_bits=8, groups=4)
+        assert q.dtype == np.int8
+        back = dequantize_weight(q, scale, groups=4)
+        # int8 group quantization: worst-case error is half a step
+        step = (2.0 * np.abs(w).max() + 1e-5) / 256
+        assert np.max(np.abs(back - w)) <= step
+
+    def test_sd_quantize_megatron(self):
+        full = _megatron_sd()
+        wq = WeightQuantization(mp_size=1)
+        sd, scales = wq.sd_quantize_megatron(dict(full), 8, 4)
+        key = "language_model.transformer.layers.0.mlp.dense_h_to_4h.weight"
+        assert sd[key].dtype == np.int8
+        assert scales.shape[0] == 2  # one scale row per layer
+
+
+class TestInferenceDescriptorWiring:
+    def test_init_inference_with_descriptor(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+
+        import deepspeed_tpu as ds
+        import deepspeed_tpu.parallel.mesh as mesh_mod
+        from deepspeed_tpu.models.transformer import TransformerLM
+        from deepspeed_tpu.module_inject.containers import policy_for
+
+        policy = policy_for("megatron_gpt")
+        cfg = policy.build_config(_MegatronCfg())
+        cfg.dtype = "float32"
+        full = _megatron_sd()
+        files = _save_shards(tmp_path, _shard_megatron_sd(full, 2))
+
+        mesh_mod.reset_topology()
+        engine = ds.init_inference(
+            TransformerLM(cfg),
+            dtype="fp32",
+            checkpoint={"type": "Megatron", "checkpoints": files, "version": 2.0},
+        )
+        toks = np.random.RandomState(5).randint(0, 128, (2, 10)).astype(np.int32)
+        got = np.asarray(engine(toks))
+
+        ref_params = policy.convert_weights(full, cfg)
+        ref = np.asarray(
+            TransformerLM(cfg).apply(
+                jax.tree_util.tree_map(jnp.asarray, ref_params), toks, train=False
+            )
+        )
+        np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+
+    def test_single_file_descriptor_numpy_boundary(self, tmp_path):
+        """A one-file list takes the equal-count branch, which must still
+        hand numpy (not torch) leaves to the policy — torch bf16 tensors
+        crash np.asarray (round-5 review finding)."""
+        import torch
+
+        full = _megatron_sd()
+        files = _save_shards(tmp_path, [full])
+        # rewrite as bf16 torch tensors
+        sd = torch.load(files[0], weights_only=False)
+        sd["module"] = {k: v.to(torch.bfloat16) for k, v in sd["module"].items()}
+        torch.save(sd, files[0])
+        loader = MegatronSDLoader(files, 2.0, None)
+        _, out, _ = loader.load(mp_world_size=1, mp_rank=0)
+        merged = loader.get_module(out)
+        for v in merged.values():
+            assert isinstance(v, np.ndarray)
+
+    def test_mp_manifest_json_still_routes_to_mp_loader(self, tmp_path):
+        """checkpoint='<...>.json' pointing at an mp-checkpoint manifest must
+        keep loading via the mp path (round-5 review finding)."""
+        import jax
+        import jax.numpy as jnp
+
+        import deepspeed_tpu as ds
+        import deepspeed_tpu.parallel.mesh as mesh_mod
+        from deepspeed_tpu.models.transformer import TransformerLM
+        from deepspeed_tpu.module_inject.containers import policy_for
+
+        policy = policy_for("megatron_gpt")
+        cfg = policy.build_config(_MegatronCfg())
+        cfg.dtype = "float32"
+        params = policy.convert_weights(_megatron_sd(), cfg)
+
+        mesh_mod.reset_topology()
+        engine = ds.init_inference(TransformerLM(cfg), dtype="fp32")
+        engine.set_params(jax.tree_util.tree_map(jnp.asarray, params))
+        manifest = engine.save_mp_checkpoint(str(tmp_path / "mp"))
+        assert manifest.endswith(".json")
+        toks = np.random.RandomState(5).randint(0, 128, (2, 10)).astype(np.int32)
+        ref = np.asarray(engine(toks))
+
+        mesh_mod.reset_topology()
+        engine2 = ds.init_inference(TransformerLM(cfg), dtype="fp32", checkpoint=manifest)
+        np.testing.assert_allclose(np.asarray(engine2(toks)), ref, rtol=1e-5, atol=1e-5)
