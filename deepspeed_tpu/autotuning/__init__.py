@@ -13,4 +13,9 @@ from deepspeed_tpu.autotuning.config_templates import (
     merge_config,
     template_for_stage,
 )
-from deepspeed_tpu.autotuning.scheduler import Experiment, ExpStatus, ResourceManager
+from deepspeed_tpu.autotuning.scheduler import (
+    Experiment,
+    ExpStatus,
+    ResourceManager,
+    SubprocessTrialRunner,
+)

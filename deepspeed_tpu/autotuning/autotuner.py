@@ -6,15 +6,20 @@ ZeRO stages fit memory (``get_instantiation_memory_required_per_gpu``
 reference :278), generate a candidate-config grid, run short trials, pick
 the best by throughput/latency (``autotuning_metric``).
 
-TPU deltas: trials run in-process (one jit cache per trial; the reference
-schedules separate jobs because CUDA state is poisoned per process — XLA
-recompiles cleanly), and memory feasibility uses the analytic ZeRO
-estimator plus the compiled step's own memory analysis when available.
+TPU deltas: trials run in-process by default (one jit cache per trial; the
+reference schedules separate jobs because CUDA state is poisoned per
+process — XLA recompiles cleanly), with ``isolation="subprocess"`` for
+hardware sessions (reference ``scheduler.run_job`` parity: a killable
+process per experiment so an OOM or tunnel stall fails one trial, not the
+sweep). Memory feasibility uses the analytic ZeRO estimator plus the
+compiled step's own memory analysis when available.
 """
 
 from __future__ import annotations
 
 import itertools
+import json
+import os
 import random as _random
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -100,7 +105,20 @@ class Autotuner:
         warmup_steps: int = 2,
         max_trials: int = 50,
         hbm_bytes: int = 16 * 2**30,
+        isolation: str = "in_process",
+        user_script: Optional[str] = None,
+        trial_timeout_s: float = 600.0,
+        session_dir: Optional[str] = None,
+        trial_env: Optional[Dict[str, str]] = None,
+        num_devices: Optional[int] = None,
     ):
+        if isolation not in ("in_process", "subprocess"):
+            raise ValueError(f"isolation={isolation!r} (want in_process|subprocess)")
+        if isolation == "subprocess" and not user_script:
+            raise ValueError(
+                "subprocess isolation needs user_script (the file defining "
+                "model_factory/batch_factory/base_config for the child)"
+            )
         self.model_factory = model_factory
         self.base_config = dict(base_config)
         self.batch_factory = batch_factory
@@ -112,21 +130,58 @@ class Autotuner:
         self.warmup_steps = warmup_steps
         self.max_trials = max_trials
         self.hbm_bytes = hbm_bytes
+        self.isolation = isolation
+        self.user_script = user_script
+        self.trial_timeout_s = trial_timeout_s
+        self.session_dir = session_dir
+        self.trial_env = trial_env
+        self.num_devices = num_devices
         self.results: List[Dict] = []
 
     # --- model info (reference model_info_profile_run :663) ---------------
     def model_info(self) -> Dict[str, Any]:
+        """Parameter count via ``eval_shape`` with a ShapeDtypeStruct rng —
+        fully abstract, so NO backend is initialized: in subprocess mode the
+        parent must never claim the chip the trial children need."""
         import jax
+        import jax.numpy as jnp
 
         model = self.model_factory()
         batch = self.batch_factory(1)
+        rng_shape = jax.ShapeDtypeStruct((2,), jnp.uint32)
         shapes = jax.eval_shape(
             lambda r, b: model.init(r, b) if hasattr(model, "init") else model[0](r, b),
-            jax.random.PRNGKey(0),
+            rng_shape,
             batch,
         )
         n = sum(int(np.prod(s.shape)) for s in jax.tree_util.tree_leaves(shapes))
         return {"num_params": n}
+
+    def _device_count(self) -> int:
+        """dp width for the memory gate. In-process: the live backend.
+        Subprocess mode: probe in a killable child — ``jax.devices()`` in
+        the parent would BOTH lock the chip against the trial children and
+        hang the session on a stalled tunnel."""
+        if self.num_devices:
+            return self.num_devices
+        if self.isolation == "subprocess":
+            import subprocess
+            import sys
+
+            try:
+                out = subprocess.run(
+                    [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+                    capture_output=True,
+                    timeout=120,
+                    text=True,
+                )
+                return max(1, int(out.stdout.strip().splitlines()[-1]))
+            except Exception:
+                logger.warning("device-count probe failed; memory-gating for 1 device")
+                return 1
+        import jax
+
+        return len(jax.devices())
 
     # --- candidate grid ---------------------------------------------------
     def generate_experiments(self) -> List[Dict]:
@@ -136,9 +191,7 @@ class Autotuner:
 
         info = self.model_info()
         n_params = info["num_params"]
-        import jax
-
-        dp = len(jax.devices())
+        dp = self._device_count()
         exps = []
         for cfg in candidate_configs(self.base_config, self.stages, self.micro_batches):
             stage = cfg["zero_optimization"]["stage"]
@@ -153,11 +206,9 @@ class Autotuner:
         if self.tuner_type == "random":
             return RandomTuner(exps)
         if self.tuner_type == "model_based":
-            import jax
-
             info = self.model_info()
             return ModelBasedTuner(
-                exps, self.hbm_bytes, info["num_params"], len(jax.devices())
+                exps, self.hbm_bytes, info["num_params"], self._device_count()
             )
         return GridSearchTuner(exps)
 
@@ -197,14 +248,45 @@ class Autotuner:
             "throughput_samples_per_s": samples_per_sec,
         }
 
+    def _trial_fn(self):
+        """Per-experiment executor: in-process (fast; harness/CI) or the
+        reference-style isolated subprocess (hardware sessions — an OOM or
+        a stalled tunneled backend fails one experiment, not the sweep)."""
+        if self.isolation == "subprocess":
+            from deepspeed_tpu.autotuning.scheduler import SubprocessTrialRunner
+
+            log_path = (
+                os.path.join(self.session_dir, "trials.log") if self.session_dir else None
+            )
+            return SubprocessTrialRunner(
+                self.user_script,
+                trial_steps=self.trial_steps,
+                warmup_steps=self.warmup_steps,
+                timeout_s=self.trial_timeout_s,
+                env=self.trial_env,
+                log_path=log_path,
+            )
+        return self.run_trial
+
+    def _record_session(self) -> None:
+        """Persist the tuning session (reference writes per-exp dirs under
+        ``autotuning_exps/``): one summary json + the best config."""
+        if not self.session_dir:
+            return
+        os.makedirs(self.session_dir, exist_ok=True)
+        with open(os.path.join(self.session_dir, "session_summary.json"), "w") as f:
+            json.dump(self.scheduler.summary(), f, indent=2, default=str)
+
     def tune(self) -> Optional[Dict]:
         from deepspeed_tpu.autotuning.scheduler import ResourceManager
 
+        if self.session_dir:
+            os.makedirs(self.session_dir, exist_ok=True)
         exps = self.generate_experiments()
         logger.info(f"autotuning over {len(exps)} candidate configs")
         tuner = self._make_tuner(exps)
         # the scheduler owns execution/status; the tuner owns the visit order
-        self.scheduler = ResourceManager(self.run_trial, num_slots=1)
+        self.scheduler = ResourceManager(self._trial_fn(), num_slots=1)
         trials = 0
         while tuner.has_next() and trials < self.max_trials:
             batch = tuner.next_batch(1)
@@ -213,6 +295,7 @@ class Autotuner:
         for exp in self.scheduler.run():
             if exp.result is not None:
                 self.results.append(exp.result)
+        self._record_session()
         if not self.results:
             return None
         if self.metric == AUTOTUNING_METRIC_LATENCY:
@@ -224,25 +307,43 @@ class Autotuner:
             f"micro={best['config']['train_micro_batch_size_per_gpu']} "
             f"({best['throughput_samples_per_s']:.1f} samples/s)"
         )
+        if self.session_dir:
+            with open(os.path.join(self.session_dir, "best_config.json"), "w") as f:
+                json.dump(best, f, indent=2, default=str)
         return best
+
+
+def load_user_script(path: str) -> Dict[str, Any]:
+    """Exec the tuning user script and validate its contract — shared by the
+    CLI entry and the subprocess trial runner so both fail with the same
+    diagnostic instead of a bare KeyError."""
+    namespace: Dict[str, Any] = {}
+    with open(path) as f:
+        code = f.read()
+    exec(compile(code, path, "exec"), namespace)  # noqa: S102
+    required = ("model_factory", "batch_factory", "base_config")
+    if not all(k in namespace for k in required):
+        raise RuntimeError(
+            f"autotuning requires the script to define {required} "
+            "(see deepspeed_tpu.autotuning.Autotuner)"
+        )
+    return namespace
 
 
 def run_autotuning(args) -> int:
     """CLI entry (reference runner.py:360): the user script is expected to
     define ``model_factory``/``batch_factory``/``base_config``; exec it and
     tune."""
-    namespace: Dict[str, Any] = {}
-    with open(args.user_script) as f:
-        code = f.read()
-    exec(compile(code, args.user_script, "exec"), namespace)  # noqa: S102
-    required = ("model_factory", "batch_factory", "base_config")
-    if not all(k in namespace for k in required):
-        raise RuntimeError(
-            f"--autotuning requires the script to define {required} "
-            "(see deepspeed_tpu.autotuning.Autotuner)"
-        )
+    namespace = load_user_script(args.user_script)
     tuner = Autotuner(
-        namespace["model_factory"], namespace["base_config"], namespace["batch_factory"]
+        namespace["model_factory"],
+        namespace["base_config"],
+        namespace["batch_factory"],
+        # CLI sessions are hardware sessions: reference-style isolated
+        # trials + a persisted session record
+        isolation="subprocess",
+        user_script=args.user_script,
+        session_dir=getattr(args, "autotuning_results", None) or "autotuning_results",
     )
     best = tuner.tune()
     if best is None:

@@ -6,11 +6,21 @@ ssh; here a resource is any experiment-executor slot (on one TPU host:
 usually 1 — trials share the chip serially; in a pod: one slot per slice).
 Experiments carry QUEUED → RUNNING → DONE/FAILED state, results collect as
 they finish, and the caller's tuner drains the queue in arrival order.
+
+``SubprocessTrialRunner`` is the hardware-session executor (reference
+``run_job``'s per-experiment launch): each trial runs in its own killable
+process, so an HBM OOM or a stalled tunneled backend fails ONE experiment,
+not the sweep.
 """
 
 from __future__ import annotations
 
 import enum
+import json
+import os
+import subprocess
+import sys
+import tempfile
 import time
 from typing import Any, Callable, Dict, List, Optional
 
@@ -34,6 +44,79 @@ class Experiment:
         self.error: Optional[str] = None
         self.start_time: Optional[float] = None
         self.end_time: Optional[float] = None
+
+
+class SubprocessTrialRunner:
+    """Callable trial executor that spawns ``trial_runner`` per experiment.
+
+    ``user_script`` follows the ``deepspeed --autotuning`` contract
+    (defines model_factory / batch_factory / base_config). ``timeout_s``
+    kills the whole process group — the tunneled TPU backend can stall for
+    minutes, and a stalled trial must not eat the session. ``env`` overrides
+    the child environment (e.g. JAX_PLATFORMS=cpu for harness tests)."""
+
+    def __init__(
+        self,
+        user_script: str,
+        trial_steps: int = 5,
+        warmup_steps: int = 2,
+        timeout_s: float = 600.0,
+        env: Optional[Dict[str, str]] = None,
+        log_path: Optional[str] = None,
+    ):
+        self.user_script = user_script
+        self.trial_steps = trial_steps
+        self.warmup_steps = warmup_steps
+        self.timeout_s = timeout_s
+        self.env = env
+        self.log_path = log_path or os.devnull
+
+    def __call__(self, config: Dict) -> Optional[Dict]:
+        with tempfile.TemporaryDirectory(prefix="ds_tune_") as tmp:
+            cfg_path = os.path.join(tmp, "exp.json")
+            out_path = os.path.join(tmp, "result.json")
+            with open(cfg_path, "w") as f:
+                json.dump(config, f, default=str)
+            cmd = [
+                sys.executable,
+                "-m",
+                "deepspeed_tpu.autotuning.trial_runner",
+                "--script",
+                self.user_script,
+                "--config",
+                cfg_path,
+                "--out",
+                out_path,
+                "--trial-steps",
+                str(self.trial_steps),
+                "--warmup-steps",
+                str(self.warmup_steps),
+            ]
+            env = dict(os.environ)
+            if self.env:
+                env.update(self.env)
+            with open(self.log_path, "ab") as log:
+                proc = subprocess.Popen(
+                    cmd, stdout=log, stderr=subprocess.STDOUT, start_new_session=True, env=env
+                )
+                try:
+                    proc.wait(timeout=self.timeout_s)
+                except subprocess.TimeoutExpired:
+                    try:
+                        os.killpg(os.getpgid(proc.pid), 9)
+                    except (ProcessLookupError, PermissionError):
+                        proc.kill()
+                    proc.wait()
+            # the result file, not the rc, is the success signal — checked
+            # on the timeout path too: a child that wrote it and then hung
+            # in backend teardown still measured something
+            if not os.path.exists(out_path):
+                return None
+            try:
+                with open(out_path) as f:
+                    return json.load(f)
+            except Exception:
+                return None
 
 
 class ResourceManager:

@@ -76,12 +76,22 @@ class SDLoaderBase(ABC):
         self.module_key: Optional[str] = None
         self.ckpt_list = list(ckpt_list)
         self.version = version
+        self._first_sd: Optional[Dict[str, Any]] = None
         self.check_ckpt_list()
 
     def _load_file(self, path: str) -> Dict[str, Any]:
+        # shard 0 is read by check_ckpt_list, sanity_check AND the merge
+        # itself — cache it (shallow copy out, so set_module/quantize on one
+        # load() can't leak into the next)
+        if self._first_sd is not None and path == self.ckpt_list[0]:
+            return dict(self._first_sd)
         import torch
 
-        return torch.load(path, map_location="cpu", weights_only=False)
+        sd = torch.load(path, map_location="cpu", weights_only=False)
+        if path == self.ckpt_list[0]:
+            self._first_sd = sd
+            return dict(sd)
+        return sd
 
     def load(
         self,
@@ -204,12 +214,10 @@ class MegatronSDLoader(SDLoaderBase):
         shard — regroup before concat; 1.0/2.0: plain concat."""
         if ckpt_ver == 0:
             assert param_list[0].shape[0] % 3 == 0
-            size_qkv = param_list[0].shape[0] // 3
             split_tensors = [np.split(p, 3, axis=0) for p in param_list]
             tensors = [
                 np.concatenate([t[i] for t in split_tensors], axis=0) for i in range(3)
             ]
-            del size_qkv
             return np.concatenate(tensors, axis=0)
         if ckpt_ver in (1.0, 2.0):
             return np.concatenate(param_list, axis=0)

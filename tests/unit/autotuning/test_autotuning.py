@@ -145,3 +145,119 @@ class TestResourceManager:
         rm.schedule_all([{"id": i} for i in range(6)])
         rm.run()
         assert len(rm.successful()) == 6
+
+
+class TestSubprocessTrials:
+    """Reference scheduler.run_job parity: isolated per-experiment
+    processes with timeout + a persisted session record."""
+
+    USER_SCRIPT = '''
+import numpy as np
+from tests.unit.simple_model import SimpleModel
+
+def model_factory():
+    return SimpleModel(hidden_dim=16)
+
+def batch_factory(n):
+    rs = np.random.RandomState(0)
+    return (rs.randn(max(n, 8), 16).astype(np.float32),
+            rs.randn(max(n, 8), 16).astype(np.float32))
+
+base_config = {
+    "train_micro_batch_size_per_gpu": 1,
+    "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+    "steps_per_print": 10000,
+}
+'''
+
+    def _write_script(self, tmp_path):
+        import os
+
+        script = tmp_path / "user_tuning.py"
+        script.write_text(self.USER_SCRIPT)
+        return str(script)
+
+    def _cpu_env(self):
+        import os
+
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+        return {
+            "JAX_PLATFORMS": "cpu",
+            "PALLAS_AXON_POOL_IPS": "",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+            "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        }
+
+    def test_subprocess_trial_runs(self, tmp_path):
+        from deepspeed_tpu.autotuning.scheduler import SubprocessTrialRunner
+
+        runner = SubprocessTrialRunner(
+            self._write_script(tmp_path),
+            trial_steps=2,
+            warmup_steps=1,
+            timeout_s=300,
+            env=self._cpu_env(),
+            log_path=str(tmp_path / "trial.log"),
+        )
+        config = {
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 1},
+            "steps_per_print": 10000,
+        }
+        result = runner(config)
+        assert result is not None, (tmp_path / "trial.log").read_text()[-2000:]
+        assert result["throughput_samples_per_s"] > 0
+
+    def test_timeout_kills_trial(self, tmp_path):
+        from deepspeed_tpu.autotuning.scheduler import SubprocessTrialRunner
+
+        script = tmp_path / "hang.py"
+        script.write_text("import time\ntime.sleep(600)\n")
+        runner = SubprocessTrialRunner(str(script), timeout_s=3, env=self._cpu_env())
+        assert runner({"train_micro_batch_size_per_gpu": 1}) is None
+
+    def test_session_record(self, tmp_path):
+        import json
+
+        from deepspeed_tpu.autotuning.autotuner import Autotuner
+        from tests.unit.simple_model import SimpleModel
+        import numpy as np
+        import deepspeed_tpu.parallel.mesh as mesh_mod
+
+        mesh_mod.reset_topology()
+
+        def batch_factory(n):
+            rs = np.random.RandomState(0)
+            return (rs.randn(max(n, 8), 16).astype(np.float32),
+                    rs.randn(max(n, 8), 16).astype(np.float32))
+
+        tuner = Autotuner(
+            lambda: SimpleModel(hidden_dim=16),
+            {
+                "train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+                "steps_per_print": 10000,
+            },
+            batch_factory,
+            micro_batches=[1],
+            stages=[0, 1],
+            trial_steps=2,
+            warmup_steps=1,
+            session_dir=str(tmp_path / "session"),
+        )
+        best = tuner.tune()
+        assert best is not None
+        summary = json.loads((tmp_path / "session" / "session_summary.json").read_text())
+        assert len(summary) == 2
+        assert all(row["status"] in ("done", "failed") for row in summary)
+        best_rec = json.loads((tmp_path / "session" / "best_config.json").read_text())
+        assert best_rec["throughput_samples_per_s"] > 0
+
+    def test_subprocess_requires_script(self):
+        import pytest
+
+        from deepspeed_tpu.autotuning.autotuner import Autotuner
+
+        with pytest.raises(ValueError, match="user_script"):
+            Autotuner(lambda: None, {}, lambda n: None, isolation="subprocess")
