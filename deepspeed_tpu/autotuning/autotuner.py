@@ -136,13 +136,17 @@ class Autotuner:
         self.session_dir = session_dir
         self.trial_env = trial_env
         self.num_devices = num_devices
+        self._model_info: Optional[Dict[str, Any]] = None
         self.results: List[Dict] = []
 
     # --- model info (reference model_info_profile_run :663) ---------------
     def model_info(self) -> Dict[str, Any]:
         """Parameter count via ``eval_shape`` with a ShapeDtypeStruct rng —
         fully abstract, so NO backend is initialized: in subprocess mode the
-        parent must never claim the chip the trial children need."""
+        parent must never claim the chip the trial children need. Memoized:
+        generate_experiments and the model-based tuner both consult it."""
+        if getattr(self, "_model_info", None) is not None:
+            return self._model_info
         import jax
         import jax.numpy as jnp
 
@@ -155,7 +159,8 @@ class Autotuner:
             batch,
         )
         n = sum(int(np.prod(s.shape)) for s in jax.tree_util.tree_leaves(shapes))
-        return {"num_params": n}
+        self._model_info = {"num_params": n}
+        return self._model_info
 
     def _device_count(self) -> int:
         """dp width for the memory gate. In-process: the live backend.
@@ -168,20 +173,28 @@ class Autotuner:
             import subprocess
             import sys
 
+            # probe under the SAME env the trial children get — a cpu-forced
+            # harness run must not gate memory on the hardware device count
+            env = dict(os.environ)
+            if self.trial_env:
+                env.update(self.trial_env)
             try:
                 out = subprocess.run(
                     [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
                     capture_output=True,
                     timeout=120,
                     text=True,
+                    env=env,
                 )
-                return max(1, int(out.stdout.strip().splitlines()[-1]))
+                self.num_devices = max(1, int(out.stdout.strip().splitlines()[-1]))
             except Exception:
                 logger.warning("device-count probe failed; memory-gating for 1 device")
-                return 1
+                self.num_devices = 1
+            return self.num_devices
         import jax
 
-        return len(jax.devices())
+        self.num_devices = len(jax.devices())
+        return self.num_devices
 
     # --- candidate grid ---------------------------------------------------
     def generate_experiments(self) -> List[Dict]:
@@ -335,6 +348,12 @@ def run_autotuning(args) -> int:
     define ``model_factory``/``batch_factory``/``base_config``; exec it and
     tune."""
     namespace = load_user_script(args.user_script)
+    # session dir: the ds config's autotuning.results_dir when set
+    # (reference AUTOTUNING_RESULTS_DIR), else ./autotuning_results
+    session_dir = (
+        (namespace["base_config"].get("autotuning") or {}).get("results_dir")
+        or "autotuning_results"
+    )
     tuner = Autotuner(
         namespace["model_factory"],
         namespace["base_config"],
@@ -343,7 +362,7 @@ def run_autotuning(args) -> int:
         # trials + a persisted session record
         isolation="subprocess",
         user_script=args.user_script,
-        session_dir=getattr(args, "autotuning_results", None) or "autotuning_results",
+        session_dir=session_dir,
     )
     best = tuner.tune()
     if best is None:

@@ -14,6 +14,8 @@ from deepspeed_tpu.checkpoint.reference_ingest import (
     merge_reference_zero_fp32,
     read_universal_dir,
 )
+from deepspeed_tpu.checkpoint import constants
+from deepspeed_tpu.checkpoint.constants import *  # noqa: F401,F403 - reference surface
 from deepspeed_tpu.checkpoint.reshape_3d import (
     Model3DDescriptor,
     describe_checkpoint,
@@ -22,6 +24,16 @@ from deepspeed_tpu.checkpoint.reshape_3d import (
     read_reference_layout,
     reshape_checkpoint_3d,
     write_reference_layout,
+)
+
+# reference API-name aliases (deepspeed/checkpoint/__init__.py surface)
+model_3d_desc = Model3DDescriptor
+get_model_3d_descriptor = describe_checkpoint
+from deepspeed_tpu.checkpoint.utils import (  # noqa: E402
+    clone_tensors_for_torch_save,
+    get_layer_ckpt_name_for_rank,
+    get_model_ckpt_name_for_rank,
+    get_zero_ckpt_name_for_rank,
 )
 from deepspeed_tpu.checkpoint.reshape_utils import (
     ReshapeMeg2D,

@@ -109,7 +109,12 @@ def export_reference_checkpoint(
         "global_steps": int(engine.global_steps),
         "ds_version": "0.10.2+tpu",
     }
-    torch.save(model_state, os.path.join(path, "mp_rank_00_model_states.pt"))
+    from deepspeed_tpu.checkpoint.utils import (
+        get_model_ckpt_name_for_rank,
+        get_zero_ckpt_name_for_rank,
+    )
+
+    torch.save(model_state, get_model_ckpt_name_for_rank(path, "00"))
 
     for dp, part in enumerate(partitions):
         optim_state = {
@@ -124,7 +129,7 @@ def export_reference_checkpoint(
         }
         torch.save(
             optim_state,
-            os.path.join(path, f"zero_pp_rank_{dp}_mp_rank_00_optim_states.pt"),
+            get_zero_ckpt_name_for_rank(path, dp, 0),
         )
 
     with open(os.path.join(save_dir, "latest"), "w") as f:

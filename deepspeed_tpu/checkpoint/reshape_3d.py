@@ -41,6 +41,10 @@ from deepspeed_tpu.checkpoint.reshape_utils import (
     partition_data,
     split_tp_slices,
 )
+from deepspeed_tpu.checkpoint.utils import (
+    get_model_ckpt_name_for_rank,
+    get_zero_ckpt_name_for_rank,
+)
 from deepspeed_tpu.utils.logging import log_dist
 
 
@@ -296,7 +300,7 @@ def write_reference_layout(
                     "param_shapes": [shapes],
                     "global_state": canon["global"],
                 },
-                os.path.join(path, f"mp_rank_{rank:02d}_model_states.pt"),
+                get_model_ckpt_name_for_rank(path, f"{rank:02d}"),
             )
             if not has_zero:
                 continue
@@ -332,7 +336,7 @@ def write_reference_layout(
                     }
                 torch.save(
                     {"optimizer_state_dict": osd},
-                    os.path.join(path, f"zero_pp_rank_{d}_mp_rank_{rank:02d}_optim_states.pt"),
+                    get_zero_ckpt_name_for_rank(path, d, rank),
                 )
     return path
 

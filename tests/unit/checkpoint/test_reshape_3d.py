@@ -211,3 +211,46 @@ class TestSharpEdges:
         assert back["layers"]["01"]["wq"].shape == (3, 4)
         # nominal axis survives for future re-splits at a compatible tp
         assert back["tp_axes"]["01"]["wq"] == 0
+
+
+class TestReferenceApiSurface:
+    """Reference deepspeed/checkpoint/__init__.py name parity."""
+
+    def test_aliases_and_constants(self):
+        from deepspeed_tpu.checkpoint import (
+            MODEL_FILE_PREFIX,
+            ZERO_FILE_PREFIX,
+            get_layer_ckpt_name_for_rank,
+            get_model_ckpt_name_for_rank,
+            get_model_3d_descriptor,
+            get_zero_ckpt_name_for_rank,
+            model_3d_desc,
+        )
+
+        assert MODEL_FILE_PREFIX == "mp_rank_"
+        assert ZERO_FILE_PREFIX == "zero_pp_rank_"
+        assert model_3d_desc is Model3DDescriptor
+        assert get_model_3d_descriptor is describe_checkpoint
+        assert get_model_ckpt_name_for_rank("/b", "00") == "/b/mp_rank_00_model_states.pt"
+        assert (
+            get_zero_ckpt_name_for_rank("/b", 3, 1)
+            == "/b/zero_pp_rank_3_mp_rank_01_optim_states.pt"
+        )
+        # the reference's own helper emits the underscore form
+        # (utils.py:30: f'{layer_id}-model_{tp:02d}{MODEL_FILE_SUFFIX}')
+        assert (
+            get_layer_ckpt_name_for_rank("/b", "layer_01", 2)
+            == "/b/layer_01-model_02_model_states.pt"
+        )
+
+    def test_clone_tensors_for_torch_save(self):
+        import jax.numpy as jnp
+
+        from deepspeed_tpu.checkpoint import clone_tensors_for_torch_save
+
+        out = clone_tensors_for_torch_save(
+            {"a": jnp.ones((2,)), "b": [jnp.zeros((3,)), 7], "c": "x"}
+        )
+        assert isinstance(out["a"], np.ndarray)
+        assert isinstance(out["b"][0], np.ndarray)
+        assert out["b"][1] == 7 and out["c"] == "x"
