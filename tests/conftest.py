@@ -46,6 +46,17 @@ def _fresh_topology():
     mesh_mod.reset_topology()
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_per_module():
+    """Drop compiled executables between test FILES. After ~60 in-process
+    tests the accumulated executables/live buffers degrade the 8-device CPU
+    mesh pathologically (observed 2026-07-31: test_spatial runs 43s fresh
+    but sat >45 min at full CPU when reached through the suite); per-module
+    clearing bounds that state at a small recompilation cost."""
+    yield
+    jax.clear_caches()
+
+
 @pytest.fixture
 def eight_devices():
     devs = jax.devices()
