@@ -118,8 +118,10 @@ class CompressedModule(DSModule):
         self.inner = inner
         self.rows = _method_specs(compression_config)
         self.enabled_methods = {m for m, _, _ in self.rows}
-        # staging: methods activate at their schedule_offset; a step change
-        # that flips a row's activation retraces the jitted step once
+        # staging: methods activate at their schedule_offset. active_rows is
+        # read at TRACE time — direct apply() picks a flip up immediately,
+        # but an engine's cached step needs the CompressionScheduler(engine=)
+        # edge-triggered rebuild to see it
         self._step = 0
         logger.info(
             f"init_compression: {len(self.rows)} group(s), methods={sorted(self.enabled_methods)}"
@@ -197,15 +199,29 @@ def redundancy_clean(params, deepspeed_config, mpu=None):  # noqa: ARG001
 class CompressionScheduler:
     """Drives the staging (reference ``compression_scheduler``): call
     ``step(global_step)`` each optimizer step; the wrapped module's method
-    groups activate/deactivate per their schedule_offset windows."""
+    groups activate/deactivate per their schedule_offset windows.
 
-    def __init__(self, module: "CompressedModule"):
+    Pass the TRAINING ENGINE too when the module is driven through
+    ``deepspeed.initialize``: the engine's step programs are traced once,
+    and ``active_rows`` is read at trace time — without a retrace a
+    mid-training activation would never reach the compiled forward. The
+    scheduler detects the activation edge and rebuilds the engine's jitted
+    step exactly once per flip."""
+
+    def __init__(self, module: "CompressedModule", engine=None):
         if not isinstance(module, CompressedModule):
             raise TypeError("CompressionScheduler wraps a CompressedModule")
         self.module = module
+        self.engine = engine
 
     def step(self, global_step: int) -> None:
+        if self.engine is None:
+            self.module.set_step(global_step)
+            return
+        before = self.module.active_rows()
         self.module.set_step(global_step)
+        if self.module.active_rows() != before:
+            self.engine.invalidate_compiled_step()
 
     def active_methods(self):
         return sorted({m for m, _, _ in self.module.active_rows()})

@@ -404,7 +404,7 @@ class DeepSpeedEngine:
         self.micro_steps = 0
         self.tput_timer.batch_size = train_batch_size
         if self._initialized:
-            self._build_jitted_fns()
+            self.invalidate_compiled_step()
             if self._fused_step_enabled:
                 self._grad_acc = None
             elif self._grad_acc is None:
@@ -804,6 +804,15 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # jitted programs
     # ------------------------------------------------------------------
+    def invalidate_compiled_step(self) -> None:
+        """Re-trace the step programs on the next call. For wrappers whose
+        apply() reads Python-level state at TRACE time (compression staging:
+        ``CompressedModule.active_rows`` flips at ``schedule_offset``) — the
+        cached executables would otherwise keep the old forward forever.
+        The elastic-resize path uses the same rebuild."""
+        if self._initialized:
+            self._build_jitted_fns()
+
     def _build_jitted_fns(self) -> None:
         module = self.module
         grad_specs = self._grad_specs

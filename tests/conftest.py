@@ -46,13 +46,15 @@ def _fresh_topology():
     mesh_mod.reset_topology()
 
 
-@pytest.fixture(autouse=True, scope="module")
-def _clear_jax_caches_per_module():
-    """Drop compiled executables between test FILES. After ~60 in-process
-    tests the accumulated executables/live buffers degrade the 8-device CPU
-    mesh pathologically (observed 2026-07-31: test_spatial runs 43s fresh
-    but sat >45 min at full CPU when reached through the suite); per-module
-    clearing bounds that state at a small recompilation cost."""
+@pytest.fixture(autouse=True)
+def _clear_jax_caches():
+    """Drop compiled executables after EVERY test. Accumulated
+    executables/live buffers degrade the 8-device CPU mesh pathologically
+    (observed 2026-07-31: test_spatial runs 43s fresh but sat >45 min at
+    full CPU when reached through the suite; a module-scoped clear moved
+    the wedge into the next large module instead of removing it). The
+    recompilation cost is a few seconds per test; the wedge it prevents is
+    unbounded."""
     yield
     jax.clear_caches()
 

@@ -329,3 +329,62 @@ class TestActivationQuantization:
         assert float(wrapped.apply(params, batch, train=False)) != float(
             model.apply(params, batch, train=False)
         )
+
+
+class TestStagingThroughEngine:
+    """A schedule_offset flip must reach the ENGINE's compiled step: the
+    step programs are traced once, so the scheduler (given the engine)
+    rebuilds them on the activation edge."""
+
+    def test_midtraining_activation_changes_compiled_forward(self):
+        from deepspeed_tpu.compression import CompressionScheduler
+
+        mesh_mod.reset_topology()
+        cfg = {
+            "weight_quantization": {
+                "shared_parameters": {"enabled": True, "schedule_offset": 3},
+                "different_groups": {
+                    "wq1": {"params": {"start_bits": 4}, "modules": ["*"]}
+                },
+            }
+        }
+        wrapped = init_compression(SimpleModel(hidden_dim=16), cfg)
+        engine, _, _, _ = ds.initialize(
+            model=wrapped,
+            config={
+                "train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "adam", "params": {"lr": 0.0}},  # frozen
+                "steps_per_print": 1000,
+            },
+            dist_init_required=False,
+        )
+        sched = CompressionScheduler(wrapped, engine=engine)
+        rs = np.random.RandomState(0)
+        batch = (rs.randn(8, 16).astype(np.float32), rs.randn(8, 16).astype(np.float32))
+
+        def step_loss(global_step):
+            sched.step(global_step)
+            loss = engine(batch)
+            engine.backward(loss)
+            engine.step()
+            return float(loss)
+
+        rebuilds = []
+        original = engine.invalidate_compiled_step
+
+        def counting_invalidate():
+            rebuilds.append(True)
+            original()
+
+        engine.invalidate_compiled_step = counting_invalidate
+
+        pre = [step_loss(s) for s in range(3)]
+        post = step_loss(3)
+        # lr=0: params never change, so any loss difference is the compiled
+        # forward changing — 4-bit weight quantization kicking in at step 3
+        assert pre[0] == pre[1] == pre[2]
+        assert post != pre[0]
+        assert step_loss(4) == post
+        # edge-triggered: exactly ONE rebuild (at the step-3 activation),
+        # not one per step
+        assert len(rebuilds) == 1
