@@ -1,1 +1,10 @@
-"""Profiling (reference: ``deepspeed/profiling/``)."""
+"""Profiling (reference: ``deepspeed/profiling/``) + TPU-native compile
+telemetry (``compile_telemetry`` — per-program trace/compile counters and the
+persistent-compilation-cache opt-in)."""
+
+from deepspeed_tpu.profiling.compile_telemetry import (  # noqa: F401
+    CompileTelemetry,
+    InstrumentedFunction,
+    ProgramStats,
+    configure_persistent_cache,
+)

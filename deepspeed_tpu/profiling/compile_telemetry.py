@@ -1,0 +1,165 @@
+"""Compile/retrace telemetry for the engine's jitted programs.
+
+The hot loop is a handful of long-lived jitted programs (fwd_bwd, step,
+fused_step, fused_accum_step, eval); every unplanned retrace of one of them
+costs a multi-second XLA compile on the CPU mesh and minutes through the
+tunneled TPU compiler — and, accumulated, stale executables have wedged whole
+test sessions (PERF.md round 5). This module makes both visible:
+
+* ``CompileTelemetry.instrument(name, fn, **jit_kwargs)`` wraps ``jax.jit``
+  so each named program counts traces (re-entries of the python function by
+  the tracing machinery), cold dispatches (calls that triggered a trace —
+  i.e. compiles, or persistent-cache loads), total dispatches, and the wall
+  time spent in trace-triggering calls. The counters survive program
+  rebuilds: re-instrumenting under the same name accumulates into the same
+  record, so a retrace-regression guard can assert "≤1 compile across N
+  steps" without caring when the engine rebuilt its callables.
+* ``configure_persistent_cache`` opts into JAX's on-disk compilation cache so
+  repeated runs (bench retries, restarted jobs) skip cold compiles entirely.
+
+The wrapper forwards ``lower``/``eval_shape``/``clear_cache`` to the
+underlying jitted callable, so AOT inspection (donation sets, cost analysis)
+and explicit executable release keep working through it.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+
+@dataclass
+class ProgramStats:
+    """Counters for one named jitted program."""
+
+    name: str
+    traces: int = 0
+    compiles: int = 0  # dispatches that triggered a trace (cold dispatches)
+    dispatches: int = 0
+    compile_seconds: float = 0.0  # wall time of trace-triggering dispatches
+    invalidations: int = 0  # explicit clear_cache() calls
+    first_compile_at: Optional[float] = field(default=None, repr=False)
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "traces": self.traces,
+            "compiles": self.compiles,
+            "dispatches": self.dispatches,
+            "compile_seconds": round(self.compile_seconds, 4),
+            "invalidations": self.invalidations,
+        }
+
+
+class InstrumentedFunction:
+    """A ``jax.jit`` callable that feeds a shared ``ProgramStats`` record.
+
+    A dispatch that re-enters the python function (trace counter moved) is a
+    cold dispatch: trace + compile (or persistent-cache load) + first run —
+    its whole wall time is charged to ``compile_seconds``. Warm dispatches
+    only bump ``dispatches``. ``lower``/``eval_shape`` trace without
+    dispatching, so they bump ``traces`` but never ``compiles``.
+    """
+
+    def __init__(self, fn: Callable, stats: ProgramStats, jit_kwargs: Dict[str, Any]):
+        self._stats = stats
+
+        def traced(*args, **kwargs):
+            stats.traces += 1
+            return fn(*args, **kwargs)
+
+        traced.__name__ = getattr(fn, "__name__", stats.name)
+        self._jitted = jax.jit(traced, **jit_kwargs)
+
+    def __call__(self, *args, **kwargs):
+        st = self._stats
+        st.dispatches += 1
+        traces_before = st.traces
+        t0 = time.perf_counter()
+        out = self._jitted(*args, **kwargs)
+        if st.traces > traces_before:
+            st.compiles += 1
+            st.compile_seconds += time.perf_counter() - t0
+            if st.first_compile_at is None:
+                st.first_compile_at = time.time()
+        return out
+
+    # --- AOT / lifecycle pass-throughs ---------------------------------
+    def lower(self, *args, **kwargs):
+        return self._jitted.lower(*args, **kwargs)
+
+    def eval_shape(self, *args, **kwargs):
+        return self._jitted.eval_shape(*args, **kwargs)
+
+    def clear_cache(self) -> None:
+        """Release this program's compiled executables (the fix for the
+        PERF.md mid-suite wedge: rebinding the attribute alone leaves the
+        stale executable alive in jit's cache)."""
+        self._stats.invalidations += 1
+        self._jitted.clear_cache()
+
+    def cache_size(self) -> int:
+        try:
+            return int(self._jitted._cache_size())
+        except Exception:
+            return -1  # jit internals moved; telemetry stays best-effort
+
+    @property
+    def stats(self) -> ProgramStats:
+        return self._stats
+
+
+class CompileTelemetry:
+    """Registry of named instrumented programs (one per engine)."""
+
+    def __init__(self):
+        self._programs: Dict[str, ProgramStats] = {}
+
+    def instrument(self, name: str, fn: Callable, **jit_kwargs) -> InstrumentedFunction:
+        """``jax.jit(fn, **jit_kwargs)`` with counters under ``name``.
+        Re-instrumenting an existing name (engine rebuild) accumulates into
+        the same record."""
+        stats = self._programs.setdefault(name, ProgramStats(name))
+        return InstrumentedFunction(fn, stats, jit_kwargs)
+
+    def stats(self) -> Dict[str, Dict[str, Any]]:
+        """Per-program counter snapshot: {name: {traces, compiles,
+        dispatches, compile_seconds, invalidations}}."""
+        return {name: s.snapshot() for name, s in sorted(self._programs.items())}
+
+    def totals(self) -> Dict[str, Any]:
+        """Aggregate counters over every instrumented program."""
+        out = {"traces": 0, "compiles": 0, "dispatches": 0, "compile_seconds": 0.0}
+        for s in self._programs.values():
+            out["traces"] += s.traces
+            out["compiles"] += s.compiles
+            out["dispatches"] += s.dispatches
+            out["compile_seconds"] += s.compile_seconds
+        out["compile_seconds"] = round(out["compile_seconds"], 4)
+        return out
+
+    def reset(self) -> None:
+        self._programs.clear()
+
+
+def configure_persistent_cache(cache_dir: str, min_compile_secs: float = 0.0) -> bool:
+    """Opt into JAX's persistent compilation cache at ``cache_dir``.
+
+    Process-global (jax.config): every jitted program whose compile takes
+    longer than ``min_compile_secs`` is written to disk and reloaded on the
+    next run with the same program — a restarted job or bench retry skips
+    its cold compiles. Returns False when this jax has no such config
+    (older releases), leaving the run uncached rather than failing it.
+    """
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", float(min_compile_secs)
+        )
+    except Exception:
+        return False
+    return True

@@ -27,6 +27,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from deepspeed_tpu.utils.jax_compat import shard_map
+
 from deepspeed_tpu.ops.quantizer import dequantize, quantize
 
 
@@ -48,7 +50,7 @@ def reduce_scatter_coalesced(
         # x: this chip's full contribution; each chip keeps its reduced shard
         return jax.lax.psum_scatter(x, axis_name, tiled=True)
 
-    out = jax.shard_map(
+    out = shard_map(
         body, mesh=mesh, in_specs=P(), out_specs=P(axis_name), check_vma=False
     )(buf)
     # out is the global scattered array; split per input
@@ -117,7 +119,7 @@ def quantized_reduce_scatter(
             x, axis_name, world, groups_per_shard, num_bits
         ).reshape(1, n // world)
 
-    out = jax.shard_map(
+    out = shard_map(
         body,
         mesh=mesh,
         in_specs=P(),
@@ -143,7 +145,7 @@ def quantized_all_gather(
         return quant_all_gather_local(x, axis_name, num_groups, num_bits).reshape(-1)
 
     local_shape = (shard.shape[0],) + shard.shape[1:]
-    out = jax.shard_map(
+    out = shard_map(
         body, mesh=mesh, in_specs=P(axis_name), out_specs=P(), check_vma=False
     )(shard.reshape(shard.shape[0], -1))
     return out.reshape((-1,) + shard.shape[1:])

@@ -128,6 +128,24 @@ def split_data_axis(mc: "MeshConfig", group_size: int, n_devices: int, feature: 
     mc.data_outer = data_total // data_inner
 
 
+class CompileConfig(DeepSpeedConfigModel):
+    """TPU-native compile controls.
+
+    ``fuse_grad_accum`` collapses a gas>1 optimizer step into ONE jitted
+    program — a ``lax.scan`` over the stacked microbatches running
+    fwd+bwd+accumulate, followed by the optimizer update — so the host
+    dispatches once per optimizer step instead of gas+1 times (engaged
+    through ``train_batch``; the per-microbatch forward/backward/step
+    protocol keeps the unfused programs). ``cache_dir`` opts into JAX's
+    persistent compilation cache so repeated runs skip cold compiles;
+    ``cache_min_compile_secs`` is the write threshold (0 caches everything).
+    """
+
+    fuse_grad_accum: bool = False
+    cache_dir: Optional[str] = None
+    cache_min_compile_secs: float = 0.0
+
+
 class CommsLoggerConfig(DeepSpeedConfigModel):
     enabled: bool = False
     verbose: bool = False
@@ -323,6 +341,7 @@ class DeepSpeedConfig:
         self.optimizer_config = OptimizerConfig(**get(C.OPTIMIZER, {})) if get(C.OPTIMIZER) else None
         self.scheduler_config = SchedulerConfig(**get(C.SCHEDULER, {})) if get(C.SCHEDULER) else None
         self.mesh_config = MeshConfig(**get(C.MESH, {}))
+        self.compile_config = CompileConfig(**get(C.COMPILE, {}))
         self.comms_config = CommsConfig(**{"comms_logger": get(C.COMMS_LOGGER, {})})
         self.activation_checkpointing_config = ActivationCheckpointingConfig(
             **get("activation_checkpointing", {})

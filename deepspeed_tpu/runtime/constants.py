@@ -148,6 +148,15 @@ GRAD_ACCUM_DTYPE = "grad_accum_dtype"
 GRAD_ACCUM_DTYPE_DEFAULT = None
 
 #############################################
+# Compile controls (TPU-native section)
+#############################################
+COMPILE = "compile"
+FUSE_GRAD_ACCUM = "fuse_grad_accum"
+FUSE_GRAD_ACCUM_DEFAULT = False
+COMPILE_CACHE_DIR = "cache_dir"
+COMPILE_CACHE_DIR_DEFAULT = None
+
+#############################################
 # Eigenvalue (MoQ)
 #############################################
 EIGENVALUE = "eigenvalue"

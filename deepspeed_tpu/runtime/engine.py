@@ -16,6 +16,16 @@ sharded jax.Arrays, and three jitted programs implement the hot loop:
   overflow skip costs a ``where``, not a host sync.
 * ``_eval_fwd``  — forward only.
 
+Two fused flavors collapse whole optimizer steps into ONE dispatch: at
+gas=1 the optimizer update fuses into the forward program
+(``_jit_fused_step``), and with ``compile.fuse_grad_accum`` on, gas>1 steps
+run as a ``lax.scan`` over stacked microbatches plus the update
+(``_jit_fused_accum_step``, engaged through ``train_batch``). All step-flavor
+programs donate the full state tuple (params, master, opt_state, grad_acc,
+scale_state) so XLA updates state in place instead of double-buffering it,
+and every program is wrapped in compile telemetry
+(``profiling/compile_telemetry.py``; ``engine.compile_stats()``).
+
 ZeRO stages select the sharding trees (see ``runtime/zero/partition.py``);
 nothing else changes between stages — that is the point of doing ZeRO on the
 GSPMD partitioner instead of hooks.
@@ -40,6 +50,10 @@ from deepspeed_tpu.ops.lamb.fused_lamb import FusedLamb
 from deepspeed_tpu.ops.optimizer import DSOptimizer
 from deepspeed_tpu.ops.sgd import SGD
 from deepspeed_tpu.parallel.mesh import Topology, get_topology, initialize_topology
+from deepspeed_tpu.profiling.compile_telemetry import (
+    CompileTelemetry,
+    configure_persistent_cache,
+)
 from deepspeed_tpu.runtime import constants as C
 from deepspeed_tpu.runtime.checkpoint_engine.orbax_checkpoint_engine import OrbaxCheckpointEngine
 from deepspeed_tpu.runtime.config import DeepSpeedConfig
@@ -328,8 +342,10 @@ class DeepSpeedEngine:
         self._stream_scale = 1.0
         self.partitioner: Optional[ZeroPartitioner] = None
         self._fused_step_enabled = False
+        self._fused_accum_enabled = False
         self._pending_commit = None
         self._jit_fused_step = None
+        self._jit_fused_accum_step = None
         self._profile_fn = None
         self._last_batch = None
         self._last_fwd_rng = None
@@ -340,6 +356,15 @@ class DeepSpeedEngine:
         self._jit_eval = None
         self._jit_step = None
         self._batch_spec_fn = None
+
+        # compile telemetry: every jitted program is instrumented so
+        # trace/compile/dispatch counts (and retrace regressions) are
+        # observable via compile_stats(); opt-in persistent compilation
+        # cache so repeated runs skip cold compiles
+        self._telemetry = CompileTelemetry()
+        ccfg = self._config.compile_config
+        if ccfg.cache_dir:
+            configure_persistent_cache(ccfg.cache_dir, ccfg.cache_min_compile_secs)
 
         self.training_dataloader = self.deepspeed_io(training_data) if training_data is not None else None
 
@@ -405,7 +430,7 @@ class DeepSpeedEngine:
         self.tput_timer.batch_size = train_batch_size
         if self._initialized:
             self.invalidate_compiled_step()
-            if self._fused_step_enabled:
+            if self._fused_step_enabled or self._fused_accum_enabled:
                 self._grad_acc = None
             elif self._grad_acc is None:
                 self._grad_acc = self._alloc_grad_acc()
@@ -618,9 +643,14 @@ class DeepSpeedEngine:
         self._param_specs = self.partitioner.param_specs(param_shapes)
         self._master_specs = self.partitioner.master_specs(param_shapes)
         self._grad_specs = self.partitioner.grad_accum_specs(param_shapes)
-        param_shardings = self.partitioner.shardings(self._param_specs)
-        master_shardings = self.partitioner.shardings(self._master_specs)
-        grad_shardings = self.partitioner.shardings(self._grad_specs)
+        # donation-safe: the step programs donate the full state tuple, so
+        # their out_shardings must repeat these input shardings exactly or
+        # the in-place update degrades to a double-buffering copy
+        param_shardings, master_shardings, grad_shardings = (
+            self.partitioner.donation_out_shardings(
+                self._param_specs, self._master_specs, self._grad_specs
+            )
+        )
         self._param_shardings = param_shardings
         self._master_shardings = master_shardings
         self._grad_shardings = grad_shardings
@@ -648,9 +678,13 @@ class DeepSpeedEngine:
             self._params = jax.jit(cast_tree, out_shardings=param_shardings)(master)
             self._master = master
         else:
-            # fp32 training: one copy, stored with the (possibly ZeRO-3) param
-            # sharding; the optimizer updates it directly.
-            self._params = jax.jit(lambda t: t, out_shardings=param_shardings)(master)
+            # fp32 training: one copy, stored with the ZeRO MASTER sharding
+            # from step 0 — the step programs donate it with master
+            # out-shardings, so any other initial placement makes the first
+            # step's donation unaliasable (double-buffer copy + "donated
+            # buffers were not usable" warning) and retraces the second step
+            # when the output sharding differs from the input's.
+            self._params = jax.jit(lambda t: t, out_shardings=master_shardings)(master)
             self._master = self._params
 
         if self._offload_enabled():
@@ -692,9 +726,10 @@ class DeepSpeedEngine:
 
         self._scale_state = jax.device_put(self.loss_scaler.init_state())
         self._build_jitted_fns()
-        if not self._fused_step_enabled:
+        if not self._fused_step_enabled and not self._fused_accum_enabled:
             # accumulation buffer only exists when micro-steps accumulate
-            # across calls; the fused path keeps grads inside one program.
+            # across calls; the fused paths (gas=1 fused step, or the
+            # fuse_grad_accum scan) keep grads inside one program.
             # dtype follows data_types.grad_accum_dtype (reference
             # engine.py get_data_types; fp32 default — bf16 halves the
             # buffer for gas>1 at reduced accumulation precision)
@@ -721,11 +756,60 @@ class DeepSpeedEngine:
 
         return jax.tree_util.tree_map(leaf_spec, batch)
 
-    def _place_batch(self, batch):
-        """Device-put a host batch as a global sharded array."""
-        if all(isinstance(x, jax.Array) for x in jax.tree_util.tree_leaves(batch)):
-            return batch
-        specs = self._batch_pspec(batch)
+    def _stacked_batch_pspec(self, stacked) -> Any:
+        """Batch pspec with a leading UNSHARDED gas dim (the fused program's
+        scan axis); each microbatch slice shards dim 1 over the dense-DP
+        axes and (under SP) dim 2 over the sequence axis."""
+        dp_axes = self.topology.dense_batch_axes()
+        seq = self.topology.config.sequence > 1
+
+        def leaf_spec(x):
+            nd = np.ndim(x)
+            if nd <= 1:
+                return PartitionSpec()
+            entries = [None, dp_axes]
+            if nd >= 3 and seq:
+                entries.append("sequence")
+            entries += [None] * (nd - len(entries))
+            return PartitionSpec(*entries)
+
+        return jax.tree_util.tree_map(leaf_spec, stacked)
+
+    def _place_stacked_batch(self, micro):
+        """Stack gas microbatches along a new leading scan dim and place the
+        result as one global array. Host batches stack on the host; already-
+        placed single-process jax arrays stack on device and are re-put so
+        the fused program always sees the SAME input sharding (a drifting
+        input sharding would retrace it)."""
+        leaves = jax.tree_util.tree_leaves(micro[0])
+        if leaves and all(isinstance(x, jax.Array) for x in leaves) and jax.process_count() == 1:
+            stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *micro)
+        else:
+            if jax.process_count() > 1 and any(
+                isinstance(x, jax.Array) and not x.is_fully_addressable
+                for b in micro
+                for x in jax.tree_util.tree_leaves(b)
+            ):
+                # host stacking would np.asarray a non-addressable global
+                # array; fail with the actual contract instead
+                raise NotImplementedError(
+                    "fuse_grad_accum on multi-process runs requires host "
+                    "(numpy) microbatches; pre-placed global jax.Array "
+                    "batches cannot be re-stacked across hosts — feed host "
+                    "batches or disable compile.fuse_grad_accum"
+                )
+            stacked = jax.tree_util.tree_map(
+                lambda *xs: np.stack([np.asarray(x) for x in xs]), *micro
+            )
+        return self._place_batch(stacked, specs=self._stacked_batch_pspec(stacked))
+
+    def _place_batch(self, batch, specs=None):
+        """Device-put a host batch as a global sharded array. An explicit
+        ``specs`` tree forces (re)placement even of already-placed arrays."""
+        if specs is None:
+            if all(isinstance(x, jax.Array) for x in jax.tree_util.tree_leaves(batch)):
+                return batch
+            specs = self._batch_pspec(batch)
         shardings = jax.tree_util.tree_map(
             lambda s: NamedSharding(self.mesh, s), specs, is_leaf=lambda x: isinstance(x, PartitionSpec)
         )
@@ -804,13 +888,41 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # jitted programs
     # ------------------------------------------------------------------
+    _JIT_ATTRS = (
+        "_jit_fwd_bwd",
+        "_jit_eval",
+        "_jit_step",
+        "_jit_fused_step",
+        "_jit_fused_accum_step",
+        "_jit_debug_grad",
+        "_jit_grad_stats",
+        "_jit_zero_grads",
+        "_jit_reshard_params",
+    )
+
     def invalidate_compiled_step(self) -> None:
-        """Re-trace the step programs on the next call. For wrappers whose
-        apply() reads Python-level state at TRACE time (compression staging:
-        ``CompressedModule.active_rows`` flips at ``schedule_offset``) — the
-        cached executables would otherwise keep the old forward forever.
-        The elastic-resize path uses the same rebuild."""
-        if self._initialized:
+        """Re-trace the step programs on the next call AND release the stale
+        executables. For wrappers whose apply() reads Python-level state at
+        TRACE time (compression staging: ``CompressedModule.active_rows``
+        flips at ``schedule_offset``) — the cached executables would
+        otherwise keep the old forward forever. The elastic-resize path uses
+        the same rebuild.
+
+        Rebinding the attributes alone is NOT enough: jit keeps the old
+        executable alive in its cache, and accumulated stale executables
+        have wedged whole sessions (PERF.md round 5 — a micro-batch resize
+        loop reproduces it). Each old callable's cache is cleared explicitly
+        before the rebuild."""
+        for name in self._JIT_ATTRS:
+            fn = getattr(self, name, None)
+            clear = getattr(fn, "clear_cache", None)
+            if callable(clear):
+                try:
+                    clear()
+                except Exception:
+                    pass  # release is best-effort; the rebuild still detaches
+            setattr(self, name, None)
+        if self._initialized and self._param_stream is None:
             self._build_jitted_fns()
 
     def _build_jitted_fns(self) -> None:
@@ -897,7 +1009,14 @@ class DeepSpeedEngine:
                     )
                 return qgz_fwd_bwd(params, grad_acc, scale, rng, batch)
 
-        self._jit_fwd_bwd = jax.jit(fwd_bwd, donate_argnums=(1,))
+        # donation on fwd_bwd covers its only DYING input, the accumulator;
+        # params and the loss scale stay live across the whole accumulation
+        # window (every microbatch re-reads them), so they cannot be donated
+        # here — full-state donation happens where the state actually turns
+        # over: _jit_step and the fused programs below.
+        self._jit_fwd_bwd = self._telemetry.instrument(
+            "fwd_bwd", fwd_bwd, donate_argnums=(1,)
+        )
 
         def eval_fwd(params, rng, batch):
             if qwz:
@@ -907,7 +1026,7 @@ class DeepSpeedEngine:
             out = module.apply(params, batch, rngs={"dropout": rng}, train=False)
             return out
 
-        self._jit_eval = jax.jit(eval_fwd)
+        self._jit_eval = self._telemetry.instrument("eval_fwd", eval_fwd)
 
         def update_from_grads(grads32, params, master, opt_state, scale_state, lr):
             """Shared optimizer-update body: unscaled fp32 grads → new state.
@@ -981,13 +1100,18 @@ class DeepSpeedEngine:
             new_params, new_master, new_opt, new_scale_state, grad_norm, overflow = (
                 update_from_grads(grads, params, master, opt_state, scale_state, lr)
             )
-            return loss, new_params, new_master, new_opt, new_scale_state, grad_norm, overflow, rng
+            # pre-update scale returned as an OUTPUT: scale_state is donated,
+            # so the host cannot stash the input array (the buffer dies with
+            # the call), yet the debug-grad recompute needs the exact scale
+            # the step consumed
+            return loss, new_params, new_master, new_opt, new_scale_state, grad_norm, overflow, scale, rng
 
         if self._fused_step_enabled:
             if mixed:
-                self._jit_fused_step = jax.jit(
+                self._jit_fused_step = self._telemetry.instrument(
+                    "fused_step",
                     fused_step,
-                    donate_argnums=(0, 1, 2),
+                    donate_argnums=(0, 1, 2, 3),
                     out_shardings=(
                         None,
                         self._param_shardings,
@@ -997,16 +1121,18 @@ class DeepSpeedEngine:
                         None,
                         None,
                         None,
+                        None,
                     ),
                 )
             else:
                 def fp32_fused_step(master, opt_state, scale_state, lr, rng, batch, model_kwargs):
                     out = fused_step(None, master, opt_state, scale_state, lr, rng, batch, model_kwargs)
-                    return out[0], out[2], out[3], out[4], out[5], out[6], out[7]
+                    return out[0], out[2], out[3], out[4], out[5], out[6], out[7], out[8]
 
-                self._jit_fused_step = jax.jit(
+                self._jit_fused_step = self._telemetry.instrument(
+                    "fused_step",
                     fp32_fused_step,
-                    donate_argnums=(0, 1),
+                    donate_argnums=(0, 1, 2),
                     out_shardings=(
                         None,
                         self._master_shardings,
@@ -1015,10 +1141,115 @@ class DeepSpeedEngine:
                         None,
                         None,
                         None,
+                        None,
                     ),
                 )
         else:
             self._jit_fused_step = None
+
+        # fuse_grad_accum: the gas>1 hot path as ONE jitted program per
+        # optimizer step — a lax.scan over the stacked microbatches running
+        # fwd+bwd+accumulate (the accumulator is a scan carry, never an HBM
+        # buffer the host holds), then the SAME update_from_grads body the
+        # unfused step uses. One host dispatch per optimizer step instead of
+        # gas+1, and XLA overlaps the update with the last microbatch's
+        # backward. Engaged through train_batch(); the per-microbatch
+        # forward/backward/step protocol falls back to the unfused programs.
+        # qgZ stays unfused (its shard_map grad path manages its own
+        # reduction schedule); the offload paths and random-LTD (per-micro
+        # host-sampled index shapes) are structurally incompatible.
+        self._fused_accum_enabled = (
+            bool(self._config.compile_config.fuse_grad_accum)
+            and gas > 1
+            and self._host_offload is None
+            and not qgz
+            and self.random_ltd_scheduler is None
+        )
+        if self._fused_accum_enabled:
+            acc_dtype = self._grad_accum_dtype()
+
+            def fused_accum_step(params_or_none, master, opt_state, scale_state, lr, rng, stacked, model_kwargs):
+                params = master if params_or_none is None else params_or_none
+                scale = scale_state.scale
+                rng, sub = jax.random.split(rng)
+                micro_rngs = jax.random.split(sub, gas)
+
+                def micro(acc, xs):
+                    mb, r = xs
+
+                    def scaled_loss(p):
+                        return loss_of(p, mb, r, model_kwargs) * scale.astype(jnp.float32)
+
+                    loss_scaled, grads = jax.value_and_grad(scaled_loss)(params)
+                    acc = jax.tree_util.tree_map(
+                        lambda a, g, s: jax.lax.with_sharding_constraint(
+                            a + g.astype(a.dtype), NamedSharding(mesh, s)
+                        ),
+                        acc,
+                        grads,
+                        grad_specs,
+                        is_leaf=lambda x: isinstance(x, PartitionSpec),
+                    )
+                    return acc, loss_scaled / scale.astype(jnp.float32)
+
+                zero_acc = jax.tree_util.tree_map(
+                    lambda p, s: jax.lax.with_sharding_constraint(
+                        jnp.zeros(p.shape, acc_dtype), NamedSharding(mesh, s)
+                    ),
+                    params,
+                    grad_specs,
+                    is_leaf=lambda x: isinstance(x, PartitionSpec),
+                )
+                acc, losses = jax.lax.scan(micro, zero_acc, (stacked, micro_rngs))
+                loss = jnp.mean(losses)
+                inv = 1.0 / (scale * gas)
+                grads32 = jax.tree_util.tree_map(
+                    lambda g: g.astype(jnp.float32) * inv, acc
+                )
+                new_params, new_master, new_opt, new_scale_state, grad_norm, overflow = (
+                    update_from_grads(grads32, params, master, opt_state, scale_state, lr)
+                )
+                return loss, new_params, new_master, new_opt, new_scale_state, grad_norm, overflow, scale, rng
+
+            if mixed:
+                self._jit_fused_accum_step = self._telemetry.instrument(
+                    "fused_accum_step",
+                    fused_accum_step,
+                    donate_argnums=(0, 1, 2, 3),
+                    out_shardings=(
+                        None,
+                        self._param_shardings,
+                        self._master_shardings,
+                        self._opt_shardings,
+                        None,
+                        None,
+                        None,
+                        None,
+                        None,
+                    ),
+                )
+            else:
+                def fp32_fused_accum_step(master, opt_state, scale_state, lr, rng, stacked, model_kwargs):
+                    out = fused_accum_step(None, master, opt_state, scale_state, lr, rng, stacked, model_kwargs)
+                    return out[0], out[2], out[3], out[4], out[5], out[6], out[7], out[8]
+
+                self._jit_fused_accum_step = self._telemetry.instrument(
+                    "fused_accum_step",
+                    fp32_fused_accum_step,
+                    donate_argnums=(0, 1, 2),
+                    out_shardings=(
+                        None,
+                        self._master_shardings,
+                        self._opt_shardings,
+                        None,
+                        None,
+                        None,
+                        None,
+                        None,
+                    ),
+                )
+        else:
+            self._jit_fused_accum_step = None
 
         if self._host_offload is not None:
             # offload path: the fused device step is replaced by (tiny jitted
@@ -1031,21 +1262,26 @@ class DeepSpeedEngine:
                 )
                 return jnp.sqrt(sq) * inv, overflow
 
-            self._jit_grad_stats = jax.jit(grad_stats)
-            self._jit_zero_grads = jax.jit(
+            self._jit_grad_stats = self._telemetry.instrument("grad_stats", grad_stats)
+            self._jit_zero_grads = self._telemetry.instrument(
+                "zero_grads",
                 lambda t: jax.tree_util.tree_map(jnp.zeros_like, t),
                 donate_argnums=(0,),
             )
-            self._jit_reshard_params = jax.jit(
-                lambda t: t, out_shardings=self._param_shardings
+            self._jit_reshard_params = self._telemetry.instrument(
+                "reshard_params", lambda t: t, out_shardings=self._param_shardings
             )
             self._jit_step = None
             return
 
+        # full-state donation: params, master, opt_state, grad_acc AND
+        # scale_state all turn over at the step boundary, so every one is
+        # donated and aliased in place by XLA instead of double-buffered
         if mixed:
-            self._jit_step = jax.jit(
+            self._jit_step = self._telemetry.instrument(
+                "step",
                 step_fn,
-                donate_argnums=(0, 1, 2, 3),
+                donate_argnums=(0, 1, 2, 3, 4),
                 out_shardings=(
                     self._param_shardings,
                     self._master_shardings,
@@ -1063,9 +1299,10 @@ class DeepSpeedEngine:
                 out = step_fn(None, master, opt_state, grad_acc, scale_state, lr)
                 return out[1], out[2], out[3], out[4], out[5], out[6]
 
-            self._jit_step = jax.jit(
+            self._jit_step = self._telemetry.instrument(
+                "step",
                 fp32_step,
-                donate_argnums=(0, 1, 2),
+                donate_argnums=(0, 1, 2, 3),
                 out_shardings=(
                     self._master_shardings,
                     self._opt_shardings,
@@ -1146,24 +1383,38 @@ class DeepSpeedEngine:
             # the inputs were donated — adopt the new state immediately so the
             # engine never holds references to deleted buffers
             if self.mixed_precision:
-                loss, self._params, self._master, self._opt_state, self._scale_state, norm, ovf, self._rng = out
+                loss, self._params, self._master, self._opt_state, self._scale_state, norm, ovf, pre_scale, self._rng = out
             else:
-                loss, self._master, self._opt_state, self._scale_state, norm, ovf, self._rng = out
+                loss, self._master, self._opt_state, self._scale_state, norm, ovf, pre_scale, self._rng = out
                 self._params = self._master
             self._pending_commit = (norm, ovf)
             # host-side batch reference only (no HBM pin) for the on-demand
-            # debug-grad surface (get_last_grads); the pre-update scale array
-            # is NOT donated, so stashing it keeps the exact scale the step
-            # consumed even after a dynamic-loss-scale update
+            # debug-grad surface (get_last_grads); scale_state is donated, so
+            # the exact scale the step consumed comes back as a program
+            # OUTPUT (pre_scale) — it survives the dynamic-loss-scale update
             self._last_batch = batch
             self._last_fwd_rng = parent_rng
             # the exact kwargs the step consumed (LTD indices included) — the
             # debug-grad surface must NOT resample them
             self._last_model_kwargs = model_kwargs
-            self._last_fwd_scale = fwd_args[3 if self.mixed_precision else 2].scale
+            self._last_fwd_scale = pre_scale
             self._last_loss = loss
             self._in_forward = True
         elif self._training_mode:
+            if self._grad_acc is None:
+                # fuse_grad_accum engages only through train_batch(); a
+                # caller driving per-microbatch forward/backward/step falls
+                # back to the unfused programs (and pays per-microbatch
+                # dispatch again), which need the accumulation buffer
+                if self._fused_accum_enabled and not getattr(self, "_warned_unfused_fallback", False):
+                    self._warned_unfused_fallback = True
+                    logger.warning(
+                        "fuse_grad_accum is on but forward() is being driven "
+                        "per microbatch; the single-dispatch fused step only "
+                        "engages through train_batch() — falling back to the "
+                        "unfused per-microbatch programs"
+                    )
+                self._grad_acc = self._alloc_grad_acc()
             fwd_args = (
                 self._params, self._grad_acc, self._scale_state.scale, step_rng, placed,
                 self._model_kwargs(placed),
@@ -1519,25 +1770,135 @@ class DeepSpeedEngine:
         ]
         if self._last_loss is not None:
             events.append(("Train/Samples/train_loss", float(jax.device_get(self._last_loss)), self.global_samples))
+        totals = self._telemetry.totals()
+        events.append(("Train/Samples/compile_count", float(totals["compiles"]), self.global_samples))
+        events.append(("Train/Samples/compile_seconds", float(totals["compile_seconds"]), self.global_samples))
         self.monitor.write_events(events)
 
+    def compile_stats(self) -> Dict[str, Dict[str, Any]]:
+        """Per-program compile telemetry snapshot: for each jitted program
+        (fwd_bwd, step, fused_step, fused_accum_step, eval_fwd, ...) the
+        trace count, compile count (trace-triggering dispatches), total
+        dispatch count, wall time spent in compiling dispatches, and
+        explicit invalidations. The steady-state contract: with
+        fuse_grad_accum on and gas>1, ``fused_accum_step`` shows exactly one
+        dispatch per optimizer step and one compile total; the unfused path
+        shows gas ``fwd_bwd`` dispatches + one ``step`` per optimizer step."""
+        return self._telemetry.stats()
+
     def train_batch(self, data_iter=None, batch=None):
-        """Convenience: run a full GAS cycle (gas × fwd/bwd + step).
+        """Convenience: run a full GAS cycle — gas × fwd/bwd + step, or,
+        with ``compile.fuse_grad_accum`` on, ONE fused jitted program for
+        the whole optimizer step.
 
         ``batch``, when given, is the FULL-step batch — its leading dim is
         sliced into ``gas`` microbatches (matching the pipeline engine's
         contract so the same caller works at any mesh.pipe)."""
         gas = self.gradient_accumulation_steps()
-        micro = self._split_step_batch(batch, gas) if batch is not None else None
+        if batch is not None:
+            micro = self._split_step_batch(batch, gas)
+        else:
+            micro = [next(data_iter) for _ in range(gas)]
+        if not self._initialized:
+            self.init_params(micro[0])
+        if (
+            self._fused_accum_enabled
+            and self._training_mode
+            and not self._in_forward
+            and self._pending_commit is None
+            and self._param_stream is None
+            and self.micro_steps % gas == 0
+            # the flops profiler hooks the per-microbatch programs; give it
+            # the unfused window it expects on its profile step
+            and not (
+                self.flops_profiler is not None
+                and self.global_steps == self._config.flops_profiler_config.profile_step
+            )
+        ):
+            return self._fused_train_batch(micro)
         losses = []
-        for g in range(gas):
-            b = micro[g] if micro is not None else next(data_iter)
+        for b in micro:
             loss = self.forward(b)
             self.backward(loss)
             self.step()
             losses.append(loss)
         total = sum(jax.device_get(l) for l in losses) / len(losses)
         return total
+
+    def _fused_train_batch(self, micro):
+        """Single-dispatch optimizer step (``compile.fuse_grad_accum``): the
+        gas microbatches are stacked along a scan axis and one jitted
+        program runs fwd+bwd+accumulate per microbatch plus the optimizer
+        update. The full state tuple (params, master, opt_state,
+        scale_state) is donated, so XLA updates it in place. Returns the
+        window's mean loss as a host scalar (same contract as the unfused
+        loop)."""
+        gas = self.gradient_accumulation_steps()
+        self.tput_timer.start()
+        self.timers(FORWARD_GLOBAL_TIMER).start()
+        if self.curriculum_scheduler is not None:
+            seqlen = self.curriculum_scheduler.update_difficulty(self.global_steps + 1)
+            micro = [_truncate_seq(b, seqlen) for b in micro]
+        stacked = self._place_stacked_batch(micro)
+        model_kwargs = self._model_kwargs()  # pld theta; random-LTD is gated off
+        parent_rng = self._rng
+        lr = self.optimizer.param_groups[0]["lr"]
+        if self.mixed_precision:
+            out = self._jit_fused_accum_step(
+                self._params, self._master, self._opt_state, self._scale_state,
+                lr, self._rng, stacked, model_kwargs,
+            )
+            (
+                loss,
+                self._params,
+                self._master,
+                self._opt_state,
+                self._scale_state,
+                self._last_grad_norm,
+                overflow_flag,
+                pre_scale,
+                self._rng,
+            ) = out
+        else:
+            out = self._jit_fused_accum_step(
+                self._master, self._opt_state, self._scale_state,
+                lr, self._rng, stacked, model_kwargs,
+            )
+            (
+                loss,
+                self._master,
+                self._opt_state,
+                self._scale_state,
+                self._last_grad_norm,
+                overflow_flag,
+                pre_scale,
+                self._rng,
+            ) = out
+            self._params = self._master
+        self._last_loss = loss
+        # a fallback window (per-microbatch protocol) may have lazily
+        # allocated the accumulator; the fused step neither reads nor zeroes
+        # it, so drop it — keeping it would hand get_last_grads a stale
+        # all-zero tree AND pin a param-sized buffer the fusion exists to free
+        self._grad_acc = None
+        # debug-grad stash (get_last_grads recomputes the LAST microbatch's
+        # grads): host batch reference, the parent rng the program split,
+        # and the pre-update scale it consumed (an output — scale_state was
+        # donated)
+        self._last_batch = micro[-1]
+        self._last_fwd_rng = parent_rng
+        self._last_model_kwargs = model_kwargs
+        self._last_fwd_scale = pre_scale
+        self.timers(FORWARD_GLOBAL_TIMER).stop(sync=False)
+        self.timers(STEP_GLOBAL_TIMER).start()
+        self.micro_steps += gas
+        self.global_samples += (
+            self.train_micro_batch_size_per_gpu() * self.data_parallel_world_size() * gas
+        )
+        self._finish_step_bookkeeping(overflow_flag)
+        self.timers(STEP_GLOBAL_TIMER).stop(sync=False)
+        self.tput_timer.stop(global_step=True)
+        return jax.device_get(loss)
 
     def _split_step_batch(self, batch, gas: int):
         """Slice a full-step batch into gas microbatches along the leading dim."""
@@ -1698,7 +2059,14 @@ class DeepSpeedEngine:
                 if self.progressive_layer_drop is not None:
                     self.progressive_layer_drop.update_state(self.global_steps)
             return path, state.get("client_state", {})
-        put_p = jax.jit(lambda t: t, out_shardings=self._param_shardings)
+        # non-offload fp32: module state IS the master — place it with the
+        # master sharding the (donating) step programs pin, mirroring
+        # init_params; everywhere else params keep their param sharding
+        fp32_single_copy = not self.mixed_precision and self._host_offload is None
+        put_p = jax.jit(
+            lambda t: t,
+            out_shardings=self._master_shardings if fp32_single_copy else self._param_shardings,
+        )
         self._params = put_p(_as_device_tree(state["module"]))
         if self._host_offload is not None:
             opt_state = state.get("optimizer")
@@ -1844,7 +2212,7 @@ class DeepSpeedEngine:
         reverted params)."""
         if self._param_stream is not None:
             return self._param_stream.debug_grads()
-        if not self._fused_step_enabled:
+        if not self._fused_step_enabled and self._grad_acc is not None:
             # contract: fp32 grads whatever grad_accum_dtype stores
             return jax.tree_util.tree_map(
                 lambda g: g.astype(jnp.float32), self._grad_acc
@@ -1861,8 +2229,13 @@ class DeepSpeedEngine:
                 g = jax.grad(scaled_loss)(params)
                 return jax.tree_util.tree_map(lambda x: x.astype(jnp.float32), g)
 
-            self._jit_debug_grad = jax.jit(dbg)
+            self._jit_debug_grad = self._telemetry.instrument("debug_grad", dbg)
         _, sub = jax.random.split(self._last_fwd_rng)
+        if self._fused_accum_enabled and not self._fused_step_enabled:
+            # replay the fused-scan key schedule: rng, sub = split(parent);
+            # micro_rngs = split(sub, gas) — the last microbatch consumed
+            # micro_rngs[-1]
+            sub = jax.random.split(sub, self.gradient_accumulation_steps())[-1]
         placed = self._place_batch(self._last_batch)
         kwargs = getattr(self, "_last_model_kwargs", None)
         if kwargs is None:

@@ -43,6 +43,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from deepspeed_tpu.utils.jax_compat import shard_map
+
 from deepspeed_tpu.runtime.module import DSModule
 from deepspeed_tpu.utils.logging import log_dist
 
@@ -301,7 +303,7 @@ class SpmdPipelineModule(DSModule):
             )
             return outs
 
-        pipelined = jax.shard_map(
+        pipelined = shard_map(
             pipeline_body,
             mesh=mesh,
             in_specs=(

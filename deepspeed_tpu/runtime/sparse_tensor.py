@@ -27,6 +27,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from deepspeed_tpu.utils.jax_compat import shard_map
+
 
 class SparseTensor:
     """Compact (indices, values) view of a row-sparse dense tensor
@@ -100,7 +102,7 @@ def _sel_bwd(data_axes, res, g):
         return _scatter_rows(toks_all, g_all, vocab, dtype)
 
     batch_spec = axes if len(axes) > 1 else axes[0]
-    d_table = jax.shard_map(
+    d_table = shard_map(
         inner,
         mesh=mesh,
         in_specs=(P(batch_spec, None), P(batch_spec, None, None)),

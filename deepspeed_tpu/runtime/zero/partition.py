@@ -187,6 +187,21 @@ class ZeroPartitioner:
             is_leaf=lambda x: isinstance(x, PartitionSpec),
         )
 
+    def donation_out_shardings(self, *spec_trees: Any) -> Tuple[Any, ...]:
+        """NamedSharding trees for a donated-state output tuple.
+
+        Buffer donation (``jit donate_argnums``) only aliases a donated
+        input into an output whose sharding — hence device byte layout — is
+        identical. A step program that donates (params, master, opt_state,
+        grad_acc, scale_state) must therefore pin ``out_shardings`` to
+        exactly the input sharding trees: an omitted or re-derived
+        out-sharding lets the partitioner pick a different layout and
+        silently turns the in-place update into a copy, double-buffering
+        the whole training state in HBM. This helper is the single place
+        that materializes those trees, so the donation contract is explicit
+        at the call site."""
+        return tuple(self.shardings(t) for t in spec_trees)
+
 
 def estimate_zero_memory(
     n_params: int,

@@ -39,6 +39,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from deepspeed_tpu.utils.jax_compat import shard_map
+
 from deepspeed_tpu.ops.quantizer import quantize
 from deepspeed_tpu.parallel.mesh import Topology
 from deepspeed_tpu.runtime.comm.coalesced_collectives import (
@@ -237,7 +239,7 @@ def build_qgz_fwd_bwd(
             )
             return jax.lax.pmean(loss_local, axis), g
 
-        loss_scaled, grads = jax.shard_map(
+        loss_scaled, grads = shard_map(
             body,
             mesh=mesh,
             in_specs=(param_spec_tree, P(), P(), batch_specs),

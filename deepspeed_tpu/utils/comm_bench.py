@@ -14,6 +14,8 @@ import time
 
 import numpy as np
 
+from deepspeed_tpu.utils.jax_compat import shard_map
+
 
 def _bw_gb(op: str, size_bytes: int, seconds: float, n: int) -> float:
     """Bus bandwidth in GB/s (ring-algorithm accounting, comms_logging.get_bw)."""
@@ -52,7 +54,7 @@ def run_sweep(sizes_mb, trials: int = 5, warmups: int = 2):
         elems = max(elems - elems % (n * n), n * n)
         for name, op in ops.items():
             fn = jax.jit(
-                jax.shard_map(
+                shard_map(
                     op,
                     mesh=mesh,
                     in_specs=P("x"),

@@ -1,0 +1,38 @@
+"""Version-compat shims for jax APIs the codebase relies on.
+
+``shard_map`` moved twice across the jax releases this repo must run on:
+``jax.experimental.shard_map.shard_map`` (≤0.4.x, replication check kwarg
+``check_rep``) → ``jax.shard_map`` (≥0.5, kwarg renamed ``check_vma``).
+Call sites import ``shard_map`` from here and always use the NEW spelling
+(``check_vma``); this module translates for older jax. Keeping the shim in
+one place means a future jax bump deletes this file instead of re-touching
+every collective.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, axis_names=None, **kwargs):
+    """``jax.shard_map`` with the modern signature on any supported jax.
+
+    ``axis_names`` (new API: the mesh axes mapped manually) translates to
+    the old API's complementary ``auto`` set (the axes left to GSPMD)."""
+    if hasattr(jax, "shard_map"):
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+        if axis_names is not None:
+            kwargs["axis_names"] = axis_names
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    if check_vma is not None:
+        kwargs["check_rep"] = check_vma
+    if axis_names is not None:
+        kwargs["auto"] = frozenset(mesh.axis_names) - frozenset(axis_names)
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+    )

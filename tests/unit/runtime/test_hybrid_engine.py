@@ -94,7 +94,11 @@ def test_generate_uses_current_weights(eight_devices):
     engine.init_params(batch)
     prompts = batch["input_ids"][:, :4]
     before = np.asarray(engine.eval().generate(prompts, max_new_tokens=4))
-    params_before = jax.tree_util.tree_leaves(engine.get_params())[0]
+    # snapshot to HOST now: the step programs donate the param buffers, so a
+    # live device reference would be deleted by the first training step
+    params_before = np.asarray(
+        jax.device_get(jax.tree_util.tree_leaves(engine.get_params())[0])
+    )
 
     engine.train()
     for _ in range(3):
@@ -102,7 +106,7 @@ def test_generate_uses_current_weights(eight_devices):
         engine.backward(loss)
         engine.step()
     params_after = jax.tree_util.tree_leaves(engine.get_params())[0]
-    assert not np.array_equal(np.asarray(params_before), np.asarray(params_after))
+    assert not np.array_equal(params_before, np.asarray(params_after))
 
     after = np.asarray(engine.eval().generate(prompts, max_new_tokens=4))
     assert after.shape == before.shape

@@ -45,3 +45,51 @@ def sequence_dataloader(vocab: int = 128, seq: int = 32, total: int = 32, batch:
     for i in range(0, total, batch):
         chunk = toks[i : i + batch]
         yield {"input_ids": chunk[:, :-1], "labels": chunk[:, 1:]}
+
+
+# --- comm-free training-loop utilities -------------------------------------
+# Shared by the fused-grad-accum parity and compile-telemetry tests: drive
+# full optimizer steps on the virtual CPU mesh with no collectives beyond
+# the engine's own GSPMD-emitted ones, deterministically enough that two
+# engines built from the same config can be compared leaf-for-leaf.
+
+
+def step_batch(model_dim: int = 16, batch_size: int = 8, seed: int = 0):
+    """One deterministic FULL-step (x, y) batch for SimpleModel parity runs
+    (slice or pass to ``train_batch(batch=...)``)."""
+    rs = np.random.RandomState(seed)
+    x = rs.randn(batch_size, model_dim).astype(np.float32)
+    y = rs.randn(batch_size, model_dim).astype(np.float32)
+    return (x, y)
+
+
+def train_steps_micro(engine, batch, steps: int):
+    """Drive ``steps`` optimizer steps through the per-microbatch
+    forward/backward/step protocol, slicing ``batch`` into gas microbatches
+    each step. Returns per-step mean losses as host floats."""
+    gas = engine.gradient_accumulation_steps()
+    micro = engine._split_step_batch(batch, gas)
+    losses = []
+    for _ in range(steps):
+        vals = []
+        for b in micro:
+            loss = engine.forward(b)
+            engine.backward(loss)
+            engine.step()
+            vals.append(float(jax.device_get(loss)))
+        losses.append(sum(vals) / len(vals))
+    return losses
+
+
+def train_steps_batch(engine, batch, steps: int):
+    """Drive ``steps`` optimizer steps through ``train_batch`` (the fused
+    single-dispatch path when ``compile.fuse_grad_accum`` is on). Returns
+    per-step mean losses as host floats."""
+    return [float(engine.train_batch(batch=batch)) for _ in range(steps)]
+
+
+def master_snapshot(engine):
+    """Host copy of the fp32 master tree for cross-engine parity asserts."""
+    return jax.tree_util.tree_map(
+        lambda x: np.asarray(jax.device_get(x)), engine.get_master_params()
+    )

@@ -1,14 +1,18 @@
 #!/bin/sh
 # Fast test tier — target <10 min on the 1-core harness box (the full
 # 650+-test suite on the 8-device virtual CPU mesh runs for hours there).
-# Covers the core surface: engine + config, the whole ZeRO stack
+# Covers the core surface: engine + config, the fused grad-accum path
+# (single-dispatch parity + donation/retrace guards — catches dispatch and
+# recompile regressions per commit), the whole ZeRO stack
 # (1/2/3/offload/zero++), mesh/groups, collectives, op-builder registry,
 # MoQ, and compression. Run the FULL suite (python -m pytest tests/ -q)
 # before shipping cross-cutting changes; this tier is the per-commit loop.
-# Measured 2026-07-31: ~5 min, 195 tests.
+# Measured 2026-07-31: ~5 min, 195 tests (+22 fused/telemetry 2026-08-03).
 cd "$(dirname "$0")/.." || exit 1
 exec python -m pytest -q \
   tests/unit/runtime/test_engine.py \
+  tests/unit/runtime/test_fused_grad_accum.py \
+  tests/unit/runtime/test_compile_telemetry.py \
   tests/unit/runtime/test_config.py \
   tests/unit/runtime/test_lr_schedules.py \
   tests/unit/runtime/test_loss_scaler.py \
