@@ -22,6 +22,7 @@ is freshest, but its line prints last):
   3. ZeRO-Infinity max trainable params   (config 3, layer-streamed offload)
   4. 32k-sequence training                (config 4, flash attention + remat)
   5. MoE inference vs dense               (config 5, expert dispatch overhead)
+  6. Paged-KV continuous-batching serving (config 6, decode tokens/s/chip)
   1. GPT-2 125M ZeRO-1 training           (config 1, tokens/s/chip — headline, LAST)
 
 ``vs_baseline`` semantics per line: training configs report measured MFU
@@ -62,6 +63,7 @@ METRICS = {
     "infinity": "zero_infinity_trainable_params_per_chip",
     "long_seq": "seq32k_flash_tokens_per_sec_per_chip",
     "moe_inference": "moe8x_top1_prefill_tokens_per_sec",
+    "decode_serving": "decode_tokens_per_sec_per_chip",
 }
 
 
@@ -154,6 +156,13 @@ def _compile_fields(engine):
         or stats.get("step")
         or {}
     )
+    if not step:
+        # inference serving engines: the steady-state program is the paged
+        # decode step (one per slot bucket; dispatches sum to decode steps)
+        paged = [rec for name, rec in sorted(stats.items())
+                 if name.startswith("paged_decode_")]
+        if paged:
+            step = {"dispatches": sum(rec["dispatches"] for rec in paged)}
     return {
         "compiles": int(sum(rec["compiles"] for rec in stats.values())),
         "compile_s": round(sum(rec["compile_seconds"] for rec in stats.values()), 1),
@@ -428,6 +437,81 @@ def bench_moe_inference():
     }
 
 
+def bench_decode_serving():
+    """Config 6 (one chip): continuous-batching serving over the paged KV
+    pool (``engine.serve()``) — generated tokens/s/chip on a ragged request
+    mix. ``vs_baseline`` = paged serving throughput over the dense lockstep
+    ``generate`` on the same prompts padded to one max-budget batch (≥ ~1
+    means request-level batching serves ragged traffic at least as fast as
+    the fixed-shape batch that can't retire rows early)."""
+    import time as _time
+
+    import jax.numpy as jnp
+
+    import deepspeed_tpu as ds
+    import deepspeed_tpu.parallel.mesh as mesh_mod
+    from deepspeed_tpu.models import TransformerLM
+    from deepspeed_tpu.models.config import TransformerConfig
+
+    if TINY:
+        n_req, prompt_len, max_new = 6, 12, 8
+        mcfg = TransformerConfig(
+            vocab_size=1024, hidden_size=128, num_layers=2, num_heads=4,
+            num_kv_heads=2, max_seq_len=128, norm="rmsnorm", position="rope",
+            activation="swiglu", use_bias=False, tie_embeddings=False,
+            flash_attention=False,
+        )
+        paged = {"page_size": 8, "max_slots": 4, "prefill_chunk": 8}
+    else:
+        n_req, prompt_len, max_new = 16, 128, 128
+        mcfg = TransformerConfig(
+            vocab_size=32000, hidden_size=1024, num_layers=8, num_heads=16,
+            num_kv_heads=4, max_seq_len=1024, norm="rmsnorm", position="rope",
+            activation="swiglu", use_bias=False, tie_embeddings=False,
+        )
+        paged = {"page_size": 64, "max_slots": 8, "prefill_chunk": 128}
+
+    mesh_mod.reset_topology()
+    engine = ds.init_inference(TransformerLM(mcfg), dtype="bf16", paged_kv=paged)
+    rs = np.random.RandomState(SEED)
+    prompts = [rs.randint(0, mcfg.vocab_size, (prompt_len,)).astype(np.int32)
+               for _ in range(n_req)]
+    toks = np.stack(prompts)
+    engine.init_params(toks)
+    engine._ds_config = mcfg  # flagship family: take the KV-cached decode path
+    # ragged budgets: early finishers make room for admissions mid-stream
+    budgets = [max(1, max_new - (i * max_new) // (2 * n_req)) for i in range(n_req)]
+
+    def timed_serve():
+        t0 = _time.perf_counter()
+        outs = engine.serve(prompts, max_new_tokens=budgets)
+        gen = sum(len(o) - prompt_len for o in outs)
+        return gen / (_time.perf_counter() - t0)
+
+    timed_serve()  # compile every bucket/chunk program
+    paged_tps = timed_serve()
+    # snapshot BEFORE the dense baseline runs: the record's compile fields
+    # must describe the paged serving programs, not kv_prefill/kv_decode_loop
+    compile_fields = _compile_fields(engine)
+
+    def timed_dense():
+        t0 = _time.perf_counter()
+        out = engine.generate(jnp.asarray(toks), max_new_tokens=max_new)
+        np.asarray(out[..., -1:])  # drain
+        return n_req * max_new / (_time.perf_counter() - t0)
+
+    timed_dense()  # compile
+    dense_tps = timed_dense()
+    rec = {
+        "metric": METRICS["decode_serving"],
+        "value": round(paged_tps, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(paged_tps / dense_tps, 4),
+    }
+    rec.update(compile_fields)
+    return rec
+
+
 # ---------------------------------------------------------------------------
 # Orchestration. The parent never imports jax; every jax-touching activity
 # (including the device probe — backend init alone stalled 25 minutes in
@@ -439,6 +523,7 @@ CONFIGS = {
     "infinity": (bench_infinity_max_params, 360),
     "long_seq": (bench_long_seq, 360),
     "moe_inference": (bench_moe_inference, 300),
+    "decode_serving": (bench_decode_serving, 330),
 }
 HEADLINE = "gpt2_zero1"
 PARTIAL_PATH = os.path.join(REPO, "bench_partial.jsonl")
@@ -690,7 +775,8 @@ def main():
     # not cost the run its headline line (only a hard kill can, and the
     # child json + known-good store still hold the number then).
     try:
-        for name in ("llama_zero3", "infinity", "long_seq", "moe_inference"):
+        for name in ("llama_zero3", "infinity", "long_seq", "moe_inference",
+                     "decode_serving"):
             emit(finalize(name, run_config(name)))
 
         # If the headline errored earlier but budget remains, give it one

@@ -1,0 +1,8 @@
+"""Inference: engine, KV-cached decode, and the paged serving layer."""
+
+from deepspeed_tpu.inference.kv_pool import (  # noqa: F401
+    PagedKVCache,
+    PagePool,
+    init_paged_cache,
+)
+from deepspeed_tpu.inference.scheduler import PagedServer, Request  # noqa: F401

@@ -61,6 +61,30 @@ class CheckpointConfig(DeepSpeedConfigModel):
     base_dir: Optional[str] = None
 
 
+class PagedKVConfig(DeepSpeedConfigModel):
+    """Paged-KV serving knobs (``engine.serve()``: block-pool cache +
+    continuous batching, ``inference/kv_pool.py`` / ``inference/scheduler.py``).
+
+    Cache HBM is ``num_pages × page_size × bytes_per_token`` where
+    ``bytes_per_token = 2 · L · NKV · D · dtype_bytes`` — sized to LIVE
+    tokens instead of the dense workspace's ``batch × max_len``. With
+    ``num_pages = 0`` the pool is sized worst-case
+    (``max_slots × ceil(max_seq_len / page_size) + 1``, preemption-free);
+    set it lower to oversubscribe and trade HBM for recompute preemptions.
+    Compiled-program count is ``len(slot_buckets) + 1``: one decode program
+    per bucket, one prefill program per chunk size.
+    """
+
+    enabled: bool = True
+    page_size: int = 16
+    num_pages: int = 0  # 0 = worst-case auto-size (no preemption possible)
+    max_slots: int = 8  # concurrent sequences (rows of the decode batch)
+    slot_buckets: list = Field(default_factory=list)  # [] = powers of 2 up to max_slots
+    max_seq_len: int = 0  # 0 = the model config's max_seq_len
+    prefill_chunk: int = 32  # prompt tokens per interleaved prefill dispatch
+    attn_impl: str = "auto"  # auto | pallas | xla (decode attention backend)
+
+
 class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     replace_with_kernel_inject: bool = Field(False, alias="kernel_inject")
     dtype: DtypeEnum = DtypeEnum.bf16
@@ -72,6 +96,7 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     triangular_masking: bool = Field(True, alias="tm")
     moe: DeepSpeedMoEConfig = Field(default_factory=DeepSpeedMoEConfig)
     quant: QuantizationConfig = Field(default_factory=QuantizationConfig)
+    paged_kv: PagedKVConfig = Field(default_factory=PagedKVConfig)
     checkpoint: Optional[Any] = None
     base_dir: str = ""
     set_empty_params: bool = False

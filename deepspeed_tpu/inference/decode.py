@@ -83,15 +83,39 @@ def _layer_mlp(cfg: TransformerConfig, p, x):
     return x + _ffn_body(cfg, p, x, p["mlp_norm_scale"], p.get("mlp_norm_bias"))
 
 
+def _softmax_scale(cfg, head_dim: int) -> float:
+    return (
+        cfg.attn_softmax_scale
+        if getattr(cfg, "attn_softmax_scale", None) is not None
+        else 1.0 / float(np.sqrt(head_dim))
+    )
+
+
+def _post_attention(cfg, p, x, attn):
+    """Output projection + residual placement + mlp — shared tail of every
+    cached-attention layer (dense and paged), so the two decode paths can
+    never drift on the residual architecture."""
+    B, T = x.shape[:2]
+    attn = attn.reshape(B, T, cfg.num_heads * cfg.head_dim) @ p["wo"].astype(x.dtype)
+    if cfg.use_bias:
+        attn = attn + p["bo"].astype(x.dtype)
+    if cfg.parallel_residual:
+        # GPT-J/NeoX: mlp branch reads x (shared ln_1 or its own norm),
+        # not the attn-updated residual
+        norm_scale = p["attn_norm_scale"] if cfg.shared_parallel_norm else p["mlp_norm_scale"]
+        norm_bias = (
+            p.get("attn_norm_bias") if cfg.shared_parallel_norm else p.get("mlp_norm_bias")
+        )
+        return x + attn + _ffn_body(cfg, p, x, norm_scale, norm_bias)
+    x = x + attn
+    return _layer_mlp(cfg, p, x)
+
+
 def _cached_attention(cfg, q, k_cache, v_cache, q_positions, kv_len_mask, kv_len=None):
     """q [B,T,NH,D] against the full cache [B,S,NKV,D]; positions beyond the
     valid length are masked (the reference softmax_context semantics)."""
     NH, NKV = q.shape[2], k_cache.shape[2]
-    scale = (
-        cfg.attn_softmax_scale
-        if getattr(cfg, "attn_softmax_scale", None) is not None
-        else 1.0 / np.sqrt(q.shape[-1])
-    )
+    scale = _softmax_scale(cfg, q.shape[-1])
     if (
         q.shape[1] == 1
         and kv_len is not None
@@ -99,19 +123,29 @@ def _cached_attention(cfg, q, k_cache, v_cache, q_positions, kv_len_mask, kv_len
         and k_cache.shape[1] % 256 == 0
     ):
         # single-token decode: the fused ragged kernel reads only live cache
-        # blocks (and GQA kv rows once, without the repeat below)
+        # blocks (and GQA kv rows once, without any head expansion)
         from deepspeed_tpu.ops.transformer.decode_attention import decode_attention
 
         out = decode_attention(q[:, 0], k_cache, v_cache, kv_len, scale=scale)
         return out[:, None]
-    if NKV != NH:
-        k_cache = jnp.repeat(k_cache, NH // NKV, axis=2)
-        v_cache = jnp.repeat(v_cache, NH // NKV, axis=2)
-    scores = jnp.einsum("btnd,bsnd->bnts", q, k_cache).astype(jnp.float32) * scale
     S = k_cache.shape[1]
     kv_pos = jnp.arange(S, dtype=jnp.int32)
     causal = q_positions[:, None, :, None] >= kv_pos[None, None, None, :]
     valid = kv_len_mask[None, None, None, :] if kv_len_mask is not None else True
+    if NKV != NH:
+        # GQA: group the queries [B,T,NKV,G,D] against the shared kv rows —
+        # an NH-wide jnp.repeat of the cache here would materialize a
+        # G-times copy of the whole workspace every decode step
+        B, T, _, D = q.shape
+        G = NH // NKV
+        qg = q.reshape(B, T, NKV, G, D)
+        scores = jnp.einsum("btkgd,bskd->bkgts", qg, k_cache).astype(jnp.float32) * scale
+        mask = causal & valid  # [B, 1, T, S] -> [B, 1, 1, T, S] under kv/group axes
+        scores = jnp.where(mask[:, :, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(v_cache.dtype)
+        out = jnp.einsum("bkgts,bskd->btkgd", probs, v_cache)
+        return out.reshape(B, T, NH, D)
+    scores = jnp.einsum("btnd,bsnd->bnts", q, k_cache).astype(jnp.float32) * scale
     scores = jnp.where(causal & valid, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(v_cache.dtype)
     return jnp.einsum("bnts,bsnd->btnd", probs, v_cache)
@@ -148,26 +182,17 @@ def _forward_with_cache(cfg, params, tokens, cache: KVCache, start_pos):
         attn = _cached_attention(
             cfg, q, k_cache_l, v_cache_l, positions_b, kv_len_mask, kv_len=start_pos + T
         )
-        attn = attn.reshape(B, T, cfg.num_heads * cfg.head_dim) @ p["wo"].astype(x.dtype)
-        if cfg.use_bias:
-            attn = attn + p["bo"].astype(x.dtype)
-        if cfg.parallel_residual:
-            # GPT-J/NeoX: mlp branch reads x (shared ln_1 or its own norm),
-            # not the attn-updated residual
-            norm_scale = p["attn_norm_scale"] if cfg.shared_parallel_norm else p["mlp_norm_scale"]
-            norm_bias = (
-                p.get("attn_norm_bias") if cfg.shared_parallel_norm else p.get("mlp_norm_bias")
-            )
-            x = x + attn + _ffn_body(cfg, p, x, norm_scale, norm_bias)
-        else:
-            x = x + attn
-            x = _layer_mlp(cfg, p, x)
+        x = _post_attention(cfg, p, x, attn)
         return x, (k_cache_l, v_cache_l)
 
     x, (new_k, new_v) = jax.lax.scan(
         layer_step, x, (params["layers"], cache.k, cache.v)
     )
 
+    return _final_logits(cfg, params, x)[:, -1, :], KVCache(k=new_k, v=new_v)
+
+
+def _final_logits(cfg, params, x):
     x = _norm(
         x, params["final_norm_scale"], params.get("final_norm_bias"), cfg.norm, cfg.norm_eps
     )
@@ -177,7 +202,7 @@ def _forward_with_cache(cfg, params, tokens, cache: KVCache, start_pos):
         logits = x @ params["lm_head"].astype(x.dtype)
         if cfg.lm_head_bias:
             logits = logits + params["lm_head_bias"].astype(logits.dtype)
-    return logits[:, -1, :], KVCache(k=new_k, v=new_v)
+    return logits
 
 
 def _cfg_key(cfg) -> Tuple:
@@ -201,27 +226,45 @@ def _cfg_key(cfg) -> Tuple:
 _decoder_cache: Dict[Tuple, Tuple] = {}
 
 
-def build_decoder(cfg: TransformerConfig) -> Tuple[Any, Any]:
+def _jit(fn, telemetry, name, **jit_kwargs):
+    """jax.jit, counted under ``name`` when a CompileTelemetry is given —
+    the engines' compile_stats() path (profiling/compile_telemetry.py)."""
+    if telemetry is None:
+        return jax.jit(fn, **jit_kwargs)
+    return telemetry.instrument(name, fn, **jit_kwargs)
+
+
+def _telemetry_uid(telemetry):
+    """Program-cache key component: compiled callables built against one
+    telemetry registry must not be served to another engine's registry."""
+    return None if telemetry is None else telemetry.uid
+
+
+def build_decoder(cfg: TransformerConfig, telemetry=None) -> Tuple[Any, Any]:
     """(prefill, decode_step) jitted pair for a model config.
 
     ``prefill(params, tokens, cache)`` consumes the prompt [B, T];
     ``decode_step(params, token, cache, pos)`` appends one token [B].
     Both donate the cache buffer (in-place workspace update).
     """
-    key = _cfg_key(cfg)
+    key = (_cfg_key(cfg), _telemetry_uid(telemetry))
     if key in _decoder_cache:
         return _decoder_cache[key]
 
-    prefill = jax.jit(
+    prefill = _jit(
         lambda params, tokens, cache: _forward_with_cache(
             cfg, params, tokens, cache, jnp.int32(0)
         ),
+        telemetry,
+        "kv_prefill",
         donate_argnums=(2,),
     )
-    decode_step = jax.jit(
+    decode_step = _jit(
         lambda params, token, cache, pos: _forward_with_cache(
             cfg, params, token[:, None], cache, pos
         ),
+        telemetry,
+        "kv_decode_step",
         donate_argnums=(2,),
     )
     _decoder_cache[key] = (prefill, decode_step)
@@ -260,6 +303,7 @@ def generate(
     top_p: float = 1.0,
     pad_token_id: int = 0,
     dtype=None,
+    telemetry=None,
 ):
     """KV-cached generation: one jitted prefill + ONE jitted decode loop.
 
@@ -285,7 +329,7 @@ def generate(
     B, prompt_len = tokens.shape
     max_len = prompt_len + max_new_tokens
     cache = init_cache(cfg, B, max_len, dtype=dtype)
-    prefill, _ = build_decoder(cfg)
+    prefill, _ = build_decoder(cfg, telemetry)
     logits, cache = prefill(params, tokens, cache)
     if rng is None:
         # no rng = greedy (matching sample_logits), never a silently fixed
@@ -296,7 +340,7 @@ def generate(
     key = (
         _cfg_key(cfg), B, prompt_len, max_new_tokens, eos_token_id,
         float(temperature), int(top_k), float(top_p), int(pad_token_id),
-        str(tokens.dtype), str(cache.k.dtype),
+        str(tokens.dtype), str(cache.k.dtype), _telemetry_uid(telemetry),
     )
     loop = _loop_cache_get(key)
     if loop is None:
@@ -338,7 +382,7 @@ def generate(
             # into the loop carry
             return out, step, cache
 
-        loop = jax.jit(_loop, donate_argnums=(2, 4))
+        loop = _jit(_loop, telemetry, "kv_decode_loop", donate_argnums=(2, 4))
         _loop_cache_put(key, loop)
 
     out0 = jnp.full((B, max_len), pad_token_id, tokens.dtype)
@@ -357,6 +401,7 @@ def beam_generate(
     pad_token_id: int = 0,
     length_penalty: float = 1.0,
     dtype=None,
+    telemetry=None,
 ):
     """KV-cached beam search as ONE jitted decode loop.
 
@@ -390,7 +435,7 @@ def beam_generate(
     V = cfg.vocab_size
 
     cache = init_cache(cfg, B, max_len, dtype=dtype)
-    prefill, _ = build_decoder(cfg)
+    prefill, _ = build_decoder(cfg, telemetry)
     logits, cache = prefill(params, tokens, cache)  # [B, V]
 
     # tile to B*K OUTSIDE the loop: the loop's donated cache/out buffers are
@@ -403,7 +448,7 @@ def beam_generate(
     key = (
         "beam", _cfg_key(cfg), B, K, prompt_len, max_new_tokens,
         eos_token_id, int(pad_token_id), float(length_penalty),
-        str(tokens.dtype), str(cache.k.dtype),
+        str(tokens.dtype), str(cache.k.dtype), _telemetry_uid(telemetry),
     )
     loop = _loop_cache_get(key)
     if loop is None:
@@ -505,8 +550,143 @@ def beam_generate(
             final_len = jnp.where(use_fin, best_len, step)
             return final_out, jnp.max(final_len), cache
 
-        loop = jax.jit(_loop, donate_argnums=(2, 3))
+        loop = _jit(_loop, telemetry, "kv_beam_loop", donate_argnums=(2, 3))
         _loop_cache_put(key, loop)
 
     out, n_emitted, _ = loop(params, logits, cache, out0)
     return out[:, : prompt_len + int(jax.device_get(n_emitted))]
+
+
+# --- paged (block-table) serving programs ----------------------------------
+# The continuous-batching scheduler (inference/scheduler.py) drives these:
+# per decode step ONE dispatch of a slot-bucket-sized program; per prompt
+# chunk one dispatch of a fixed-chunk prefill program. Compiled-program
+# count is bounded by (slot buckets + 1 chunk size), never by traffic.
+
+
+def _scatter_pages(pages_l, vals, page_table, positions, page_size):
+    """Write [B, T, NKV, D] new k/v rows into one layer's page pool
+    [NP, NKV, P, D] at absolute ``positions`` [B, T] through the page table
+    [B, MAXP]. Sentinel table entries (< 0, i.e. unallocated/dead rows)
+    clamp onto the reserved trash page 0, so padded bucket rows and prompt
+    pad tails write garbage only where nothing lives."""
+    NP = pages_l.shape[0]
+    maxp = page_table.shape[1]
+    slot = jnp.clip(positions // page_size, 0, maxp - 1)
+    pid = jnp.clip(jnp.take_along_axis(page_table, slot, axis=1), 0, NP - 1)
+    off = positions % page_size
+    # advanced-index scatter: (pid, off) broadcast to [B, T] and land first,
+    # giving the [B, T, NKV, D] update window vals fills exactly
+    return pages_l.at[pid, :, off, :].set(vals)
+
+
+def _paged_forward(cfg, params, tokens, k_pages, v_pages, page_table, positions_b,
+                   attn_lens, attn_impl):
+    """Forward [B, T] tokens against the paged cache: scatter each token's
+    k/v into its page, then attend — single-token rows (T == 1) through the
+    paged decode kernel with live lengths ``attn_lens``, chunks through the
+    causal prefill attention (mask from ``positions_b``). Returns
+    (logits [B, T, V], new_k_pages, new_v_pages)."""
+    from deepspeed_tpu.ops.transformer.paged_attention import (
+        paged_decode_attention,
+        paged_prefill_attention,
+    )
+
+    B, T = tokens.shape
+    dtype = k_pages.dtype
+    P = k_pages.shape[3]
+    x = params["embed"]["tokens"].astype(dtype)[tokens]
+    if cfg.position == "learned":
+        x = x + params["embed"]["pos"].astype(dtype)[positions_b]
+    scale = _softmax_scale(cfg, cfg.head_dim)
+
+    def layer_step(x, per_layer):
+        p, kp_l, vp_l = per_layer
+        q, k_new, v_new = _layer_project_qkv(cfg, p, x)
+        if cfg.position == "rope":
+            q = _rope(q, positions_b, cfg.rope_theta, cfg.rope_dim)
+            k_new = _rope(k_new, positions_b, cfg.rope_theta, cfg.rope_dim)
+        kp_l = _scatter_pages(kp_l, k_new.astype(dtype), page_table, positions_b, P)
+        vp_l = _scatter_pages(vp_l, v_new.astype(dtype), page_table, positions_b, P)
+        # attn_lens discriminates decode from prefill: a prefill_chunk=1
+        # program also has T == 1 but must take the causal-mask path
+        if T == 1 and attn_lens is not None:
+            attn = paged_decode_attention(
+                q[:, 0], kp_l, vp_l, page_table, attn_lens, scale=scale, impl=attn_impl
+            )[:, None]
+        else:
+            attn = paged_prefill_attention(
+                q, kp_l, vp_l, page_table, positions_b, scale=scale
+            )
+        x = _post_attention(cfg, p, x, attn)
+        return x, (kp_l, vp_l)
+
+    x, (new_k, new_v) = jax.lax.scan(layer_step, x, (params["layers"], k_pages, v_pages))
+    return _final_logits(cfg, params, x), new_k, new_v
+
+
+_paged_decode_cache: Dict[Tuple, Any] = {}
+_paged_prefill_cache: Dict[Tuple, Any] = {}
+
+
+def build_paged_decode_step(cfg, bucket: int, page_size: int, attn_impl: str = "auto",
+                            telemetry=None):
+    """One-dispatch decode step for a ``bucket``-row slot batch.
+
+    ``decode_step(params, tokens [B], k_pages, v_pages, page_table [B, MAXP],
+    lengths [B]) -> (next_tokens [B], k_pages, v_pages)``: writes each row's
+    pending token at position ``lengths[b]``, attends over ``lengths[b]+1``
+    live positions, returns the greedy next token (argmax runs in-program —
+    the only host traffic per step is the [B] token fetch). Pages donated.
+    Compiled once per bucket size; MAXP rides in from the table shape.
+    """
+    if cfg.position == "alibi":
+        raise NotImplementedError("paged serving does not support alibi attention biases")
+    key = (_cfg_key(cfg), int(bucket), int(page_size), attn_impl, _telemetry_uid(telemetry))
+    fn = _paged_decode_cache.get(key)
+    if fn is not None:
+        return fn
+
+    def _decode(params, tokens, k_pages, v_pages, page_table, lengths):
+        logits, new_k, new_v = _paged_forward(
+            cfg, params, tokens[:, None], k_pages, v_pages, page_table,
+            lengths[:, None], lengths + 1, attn_impl,
+        )
+        return jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32), new_k, new_v
+
+    fn = _jit(_decode, telemetry, f"paged_decode_b{int(bucket)}", donate_argnums=(2, 3))
+    _paged_decode_cache[key] = fn
+    return fn
+
+
+def build_paged_prefill(cfg, chunk: int, page_size: int, attn_impl: str = "auto",
+                        telemetry=None):
+    """Fixed-size prompt-chunk program (one compile per chunk size).
+
+    ``prefill(params, tokens [1, C], k_pages, v_pages, page_table [1, MAXP],
+    start [1], last_idx) -> (next_token [1], k_pages, v_pages)``: scatters
+    the chunk's k/v at ``start..start+C-1``, attends causally, and returns
+    the greedy token after position ``last_idx`` (traced, so ragged final
+    chunks never retrace). Short final chunks arrive padded; pad positions
+    write beyond the live length or onto the trash page and are causally
+    invisible to every real token."""
+    if cfg.position == "alibi":
+        raise NotImplementedError("paged serving does not support alibi attention biases")
+    key = (_cfg_key(cfg), int(chunk), int(page_size), attn_impl, _telemetry_uid(telemetry))
+    fn = _paged_prefill_cache.get(key)
+    if fn is not None:
+        return fn
+
+    def _prefill(params, tokens, k_pages, v_pages, page_table, start, last_idx):
+        T = tokens.shape[1]
+        positions_b = start[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+        logits, new_k, new_v = _paged_forward(
+            cfg, params, tokens, k_pages, v_pages, page_table, positions_b,
+            None, attn_impl,
+        )
+        last = jax.lax.dynamic_index_in_dim(logits, last_idx, axis=1, keepdims=False)
+        return jnp.argmax(last, axis=-1).astype(jnp.int32), new_k, new_v
+
+    fn = _jit(_prefill, telemetry, f"paged_prefill_c{int(chunk)}", donate_argnums=(2, 3))
+    _paged_prefill_cache[key] = fn
+    return fn

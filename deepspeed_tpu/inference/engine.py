@@ -21,6 +21,7 @@ import numpy as np
 
 from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig, DtypeEnum
 from deepspeed_tpu.parallel.mesh import get_topology
+from deepspeed_tpu.profiling.compile_telemetry import CompileTelemetry
 from deepspeed_tpu.runtime.module import wrap_module
 from deepspeed_tpu.utils.logging import log_dist
 
@@ -75,6 +76,11 @@ class InferenceEngine:
         # :518 model_times): per-forward wall latency, drained at read
         self.model_profile_enabled = False
         self._model_times = []
+        # compile telemetry over every jitted program this engine runs
+        # (forward, the KV-cached decode loops, the paged serving programs)
+        # — same contract as the training engine's compile_stats()
+        self._telemetry = CompileTelemetry()
+        self._paged_server = None  # lazy; rebuilt when weights change
 
         injected = False
         if self._config.replace_with_kernel_inject and _is_hf_model(model):
@@ -204,6 +210,7 @@ class InferenceEngine:
             cast = jax.device_put(cast, shardings)
         self._params = cast
         self._jit_forward = None
+        self._paged_server = None
         if self._config.save_mp_checkpoint_path:
             # reference inference/engine.py:406: persist the sharded layout
             # the moment the weights are resident, so later engines load
@@ -360,7 +367,7 @@ class InferenceEngine:
             def fwd(params, batch, rng):
                 return module.apply(params, batch, rngs={"dropout": rng}, train=False)
 
-            self._jit_forward = jax.jit(fwd)
+            self._jit_forward = self._telemetry.instrument("forward", fwd)
         batch = inputs[0] if len(inputs) == 1 else (inputs if inputs else kwargs)
         self._rng, sub = jax.random.split(self._rng)
         return self._jit_forward(self._params, batch, sub)
@@ -426,6 +433,7 @@ class InferenceEngine:
                 eos_token_id=eos_token_id,
                 pad_token_id=pad_token_id,
                 length_penalty=length_penalty,
+                telemetry=self._telemetry,
             )
         if self._zero_config is not None:
             if self._param_stream is None:
@@ -450,6 +458,7 @@ class InferenceEngine:
                 top_k=top_k,
                 top_p=top_p,
                 pad_token_id=pad_token_id,
+                telemetry=self._telemetry,
             )
         if self._params is None:
             self.init_params(jnp.asarray(input_ids))
@@ -473,11 +482,81 @@ class InferenceEngine:
             temperature=temperature,
             top_k=top_k,
             top_p=top_p,
+            telemetry=self._telemetry,
         )
 
     # the public generate adopts _generate_impl's signature/doc — one
     # source of truth for the sampling controls
     generate = functools.wraps(_generate_impl)(generate)
+
+    # --- paged serving --------------------------------------------------
+    def compile_stats(self):
+        """Per-program compile telemetry snapshot — the inference-side
+        counterpart of the training engine's ``compile_stats()``: for each
+        jitted program (``forward``, ``kv_prefill`` / ``kv_decode_loop`` /
+        ``kv_beam_loop``, ``full_fwd_gen_step``, and the serving programs
+        ``paged_decode_b<bucket>`` / ``paged_prefill_c<chunk>``) the trace,
+        compile, and dispatch counters. The serving contract: ≤1 compile per
+        slot bucket and exactly one ``paged_decode_*`` dispatch per decode
+        step."""
+        return self._telemetry.stats()
+
+    def _build_paged_server(self):
+        from deepspeed_tpu.inference.scheduler import PagedServer
+
+        if self._ds_config is None or self._params is None:
+            raise NotImplementedError(
+                "serve() requires the kernel-injected (KV-cached) path: build "
+                "the engine with replace_with_kernel_inject or a converted "
+                "model family"
+            )
+        pcfg = self._config.paged_kv
+        if not pcfg.enabled:
+            raise ValueError("paged serving is disabled (inference config paged_kv.enabled)")
+        return PagedServer(
+            self._ds_config,
+            self._params,
+            page_size=pcfg.page_size,
+            num_pages=pcfg.num_pages,
+            max_slots=pcfg.max_slots,
+            slot_buckets=pcfg.slot_buckets or None,
+            max_seq_len=pcfg.max_seq_len,
+            prefill_chunk=pcfg.prefill_chunk,
+            attn_impl=pcfg.attn_impl,
+            dtype=self.dtype,
+            telemetry=self._telemetry,
+        )
+
+    def serve(self, prompts, max_new_tokens=32, eos_token_id=None):
+        """Continuous-batching greedy generation over the paged KV pool:
+        requests are admitted/evicted every step, prompts prefill in chunks
+        interleaved with decode, and each decode step is ONE dispatch of a
+        slot-bucket-shaped program (``inference/scheduler.py``). Accepts a
+        list of 1-D prompts (ragged — no padding to a common length) and a
+        scalar or per-request ``max_new_tokens``; returns one 1-D output
+        array per request in submission order. The server (and its page
+        pool) persists across calls, sized by the ``paged_kv`` config
+        section."""
+        if self._paged_server is None:
+            self._paged_server = self._build_paged_server()
+        return self._paged_server.serve(
+            prompts, max_new_tokens=max_new_tokens, eos_token_id=eos_token_id
+        )
+
+    def serve_stats(self):
+        """Scheduler counters of the live paged server (admitted, preempted,
+        finished, prefill_chunks, decode_steps) plus pool occupancy."""
+        if self._paged_server is None:
+            return {}
+        stats = dict(self._paged_server.stats)
+        pool = self._paged_server.pool
+        stats.update(
+            live_tokens=pool.live_tokens(),
+            used_pages=pool.used_pages(),
+            free_pages=pool.free_pages(),
+            live_hbm_bytes=pool.live_hbm_bytes(),
+        )
+        return stats
 
     def _zero_generate(self, input_ids, max_new_tokens, eos_token_id, pad_token_id,
                        temperature=0.0, top_k=0, top_p=1.0):

@@ -39,6 +39,7 @@ def greedy_generate(
     top_k: int = 0,
     top_p: float = 1.0,
     eos_check_every: int = 8,
+    telemetry=None,
 ):
     from deepspeed_tpu.inference.sampling import sample_logits
 
@@ -73,7 +74,10 @@ def greedy_generate(
             out = jax.lax.dynamic_update_slice(padded, next_tok[:, None], (0, cursor))
             return out, finished, jnp.all(finished)
 
-        step = jax.jit(_step, donate_argnums=(1,))
+        if telemetry is None:
+            step = jax.jit(_step, donate_argnums=(1,))
+        else:
+            step = telemetry.instrument("full_fwd_gen_step", _step, donate_argnums=(1,))
         if jit_cache is not None:
             jit_cache[cache_key] = step
 

@@ -1,0 +1,242 @@
+"""Block-pool KV cache for paged serving.
+
+The dense decode workspace (``inference/decode.py:init_cache``) allocates
+``[L, B, max_len, NKV, D]`` per batch — HBM scales with ``batch × max_len``
+whether or not those tokens exist. Here the cache is a shared pool of
+fixed-size pages ``[L, num_pages, NKV, page_size, D]`` plus a per-sequence
+page table: HBM holds ``live_tokens × bytes_per_token`` rounded up to page
+granularity, and any free page can serve any sequence (the vLLM block-table
+layout; the reference approximates it with contiguous per-sequence
+workspaces — ``allocate_workspace`` in
+``csrc/transformer/inference/csrc/pt_binding.cpp``).
+
+Split of responsibilities:
+
+* ``PagedKVCache`` — the device arrays. Jitted programs read/write them
+  through ``ops/transformer/paged_attention.py`` and the scatter in
+  ``inference/decode.py``; they are donated into every serving program so
+  updates alias in place.
+* ``PagePool`` — the host-side allocator: free list, per-slot page tables
+  and live lengths (numpy; they ride into each dispatch as plain int32
+  arrays, so allocation changes never retrace a program), alloc/free/defrag.
+
+Page 0 is the reserved TRASH page: it is never allocated, table sentinels
+(-1) clamp onto it inside the kernels, and dead-slot writes land there — a
+padded batch row can never corrupt a live sequence's pages.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.models.config import TransformerConfig
+
+TRASH_PAGE = 0
+
+_DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float16": jnp.float16}
+
+
+class PagedKVCache(NamedTuple):
+    """Device page pool, one stacked array per K and V.
+
+    Layout ``[L, num_pages, NKV, page_size, D]``: the layer axis scans, and
+    each layer slice is exactly the ``[NP, NKV, P, D]`` pool the paged
+    attention kernels take.
+    """
+
+    k_pages: jax.Array
+    v_pages: jax.Array
+
+    @property
+    def num_pages(self) -> int:
+        return self.k_pages.shape[1]
+
+    @property
+    def page_size(self) -> int:
+        return self.k_pages.shape[3]
+
+    @property
+    def bytes_per_token(self) -> int:
+        """HBM bytes one cached token costs across all layers (K + V)."""
+        L, _, NKV, _, D = self.k_pages.shape
+        return 2 * L * NKV * D * self.k_pages.dtype.itemsize
+
+    def hbm_bytes(self) -> int:
+        return self.k_pages.nbytes + self.v_pages.nbytes
+
+
+def init_paged_cache(
+    cfg: TransformerConfig, num_pages: int, page_size: int, dtype=None
+) -> PagedKVCache:
+    if dtype is None:
+        dtype = _DTYPES[cfg.dtype]
+    shape = (cfg.num_layers, num_pages, cfg.num_kv_heads, page_size, cfg.head_dim)
+    return PagedKVCache(k_pages=jnp.zeros(shape, dtype), v_pages=jnp.zeros(shape, dtype))
+
+
+class PagePool:
+    """Host-side page allocator over a ``PagedKVCache``.
+
+    A *slot* is one concurrently-running sequence (a row of the serving
+    batch); each slot owns a page-table row of ``max_pages_per_slot``
+    entries. ``seq_lens[slot]`` counts tokens already written. Sequences
+    acquire pages lazily as they grow and return them on ``free_slot`` —
+    total cache HBM is fixed at ``num_pages``, but the *live* footprint is
+    ``used_pages × page_size × bytes_per_token``.
+    """
+
+    def __init__(
+        self,
+        cfg: TransformerConfig,
+        num_pages: int,
+        page_size: int,
+        max_slots: int,
+        max_seq_len: Optional[int] = None,
+        dtype=None,
+    ):
+        if page_size < 1 or num_pages < 2:
+            raise ValueError("need page_size >= 1 and num_pages >= 2 (page 0 is reserved)")
+        self.page_size = int(page_size)
+        self.max_slots = int(max_slots)
+        self.max_seq_len = int(max_seq_len or cfg.max_seq_len)
+        self.max_pages_per_slot = -(-self.max_seq_len // self.page_size)
+        self.cache = init_paged_cache(cfg, num_pages, page_size, dtype=dtype)
+        # LIFO free list keeps hot pages hot; page 0 stays out of circulation
+        self._free = list(range(num_pages - 1, TRASH_PAGE, -1))
+        self._free_slots = list(range(max_slots - 1, -1, -1))
+        self.page_table = np.full((max_slots, self.max_pages_per_slot), -1, np.int32)
+        self.seq_lens = np.zeros(max_slots, np.int32)
+        self._owned = np.zeros(max_slots, np.int32)  # pages held per slot
+
+    # --- capacity accounting -------------------------------------------
+    @property
+    def num_pages(self) -> int:
+        return self.cache.num_pages
+
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def used_pages(self) -> int:
+        return self.num_pages - 1 - len(self._free)  # trash page excluded
+
+    def pages_for(self, n_tokens: int) -> int:
+        return -(-int(n_tokens) // self.page_size)
+
+    def live_tokens(self) -> int:
+        return int(self.seq_lens.sum())
+
+    def live_hbm_bytes(self) -> int:
+        """HBM actually pinned by live sequences (page-granular)."""
+        return self.used_pages() * self.page_size * self.cache.bytes_per_token
+
+    def utilization(self) -> float:
+        """Live tokens over allocated page capacity (1.0 = no page waste)."""
+        cap = self.used_pages() * self.page_size
+        return self.live_tokens() / cap if cap else 0.0
+
+    # --- slot lifecycle -------------------------------------------------
+    def can_admit(self, n_tokens: int) -> bool:
+        """A free slot exists and the pool can hold ``n_tokens`` now."""
+        return (
+            bool(self._free_slots)
+            and n_tokens <= self.max_seq_len
+            and self.pages_for(n_tokens) <= self.free_pages()
+        )
+
+    def alloc_slot(self, n_tokens: int = 0) -> Optional[int]:
+        """Claim a slot, pre-reserving pages for ``n_tokens``; None if the
+        pool cannot host it right now (caller keeps the request queued)."""
+        if not self.can_admit(max(n_tokens, 1)):
+            return None
+        slot = self._free_slots.pop()
+        self.seq_lens[slot] = 0
+        if n_tokens and not self.ensure(slot, n_tokens):
+            self.free_slot(slot)
+            return None
+        return slot
+
+    def ensure(self, slot: int, new_len: int) -> bool:
+        """Grow ``slot``'s table to cover ``new_len`` tokens. All-or-nothing:
+        on a pool-exhausted failure nothing is allocated (the caller decides
+        whom to preempt and retries)."""
+        if new_len > self.max_seq_len:
+            return False
+        need = self.pages_for(new_len) - self._owned[slot]
+        if need <= 0:
+            return True
+        if need > len(self._free):
+            return False
+        for _ in range(int(need)):
+            self.page_table[slot, self._owned[slot]] = self._free.pop()
+            self._owned[slot] += 1
+        return True
+
+    def advance(self, slot: int, n_tokens: int) -> None:
+        """Record ``n_tokens`` newly written to ``slot`` (pages must already
+        be ensured)."""
+        new_len = int(self.seq_lens[slot]) + int(n_tokens)
+        assert self.pages_for(new_len) <= self._owned[slot], (
+            f"slot {slot}: advancing to {new_len} tokens past its "
+            f"{int(self._owned[slot])} allocated pages"
+        )
+        self.seq_lens[slot] = new_len
+
+    def free_slot(self, slot: int) -> int:
+        """Release the slot and return its pages to the pool; returns how
+        many pages came back."""
+        n = int(self._owned[slot])
+        for i in range(n):
+            self._free.append(int(self.page_table[slot, i]))
+        self.page_table[slot, :] = -1
+        self.seq_lens[slot] = 0
+        self._owned[slot] = 0
+        self._free_slots.append(slot)
+        return n
+
+    # --- maintenance ----------------------------------------------------
+    def defrag(self) -> int:
+        """Compact live pages into the lowest ids (one device gather per
+        K/V), rewriting tables and rebuilding the free list. Keeps the hot
+        working set dense — e.g. so a checkpointed/snapshotted pool prefix
+        of ``used_pages + 1`` pages captures every live token. Returns the
+        number of pages that moved."""
+        live = [
+            int(self.page_table[s, i])
+            for s in range(self.max_slots)
+            for i in range(int(self._owned[s]))
+        ]
+        perm = np.arange(self.num_pages, dtype=np.int32)  # new_id -> old_id
+        remap = {}  # old_id -> new_id
+        nxt = TRASH_PAGE + 1
+        for old in live:
+            remap[old] = nxt
+            perm[nxt] = old
+            nxt += 1
+        # unassigned tail: the remaining (free) pages in any order
+        rest = [p for p in range(TRASH_PAGE + 1, self.num_pages) if p not in remap]
+        perm[nxt:] = np.asarray(rest, np.int32)
+        moves = sum(1 for old, new in remap.items() if old != new)
+        if moves == 0:
+            return 0
+        gather = jnp.asarray(perm)
+        self.cache = PagedKVCache(
+            k_pages=self.cache.k_pages[:, gather],
+            v_pages=self.cache.v_pages[:, gather],
+        )
+        for s in range(self.max_slots):
+            for i in range(int(self._owned[s])):
+                self.page_table[s, i] = remap[int(self.page_table[s, i])]
+        self._free = list(range(self.num_pages - 1, nxt - 1, -1))
+        return moves
+
+    # --- dispatch views -------------------------------------------------
+    def rows(self, slots) -> Tuple[np.ndarray, np.ndarray]:
+        """(page_table_rows, seq_lens) for a list of slots, as the int32
+        arrays a serving program takes. Padding to a bucket is the caller's
+        job (``-1`` rows / length 0 are always safe: trash-page semantics)."""
+        idx = np.asarray(slots, np.int32)
+        return self.page_table[idx], self.seq_lens[idx]

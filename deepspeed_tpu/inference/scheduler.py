@@ -1,0 +1,322 @@
+"""Continuous-batching scheduler over the paged KV pool.
+
+The dense decode path (``inference/decode.py:generate``) runs fixed-shape
+lockstep batches: every sequence prefills together, decodes together, and
+the whole batch holds its HBM until the longest row finishes. This module
+replaces that with request-level scheduling (DeepSpeed-Inference / Orca /
+vLLM style):
+
+* requests are **admitted** whenever a slot and enough pages exist, and
+  **evicted** the step they finish — cache HBM tracks live tokens;
+* prompts prefill in fixed-size **chunks interleaved with decode steps**,
+  so a long prompt never stalls tokens already streaming;
+* when the pool runs dry the **youngest** running request is preempted
+  (pages freed, request requeued); greedy decoding makes its recomputed
+  continuation token-exact, so preemption is invisible in the output;
+* compiled-program count is bounded by the **slot-count buckets**: each
+  decode step dispatches ONE program shaped to the smallest bucket covering
+  the running set, and each prompt chunk one fixed-chunk prefill program.
+  Steady state is one dispatch per decode step, ≤1 compile per bucket —
+  enforced by the serving tests via the engine's compile telemetry.
+
+``InferenceEngine.serve()`` (``inference/engine.py``) owns a ``PagedServer``
+configured from the ``inference.paged_kv`` knobs.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from deepspeed_tpu.inference.decode import build_paged_decode_step, build_paged_prefill
+from deepspeed_tpu.inference.kv_pool import PagedKVCache, PagePool
+from deepspeed_tpu.models.config import TransformerConfig
+
+
+@dataclass
+class Request:
+    """One generation request moving through the scheduler."""
+
+    uid: int
+    prompt: np.ndarray  # [Lp] int32, immutable
+    max_new_tokens: int
+    eos_token_id: Optional[int] = None
+    generated: List[int] = field(default_factory=list)
+    slot: Optional[int] = None
+    consumed: int = 0  # prefill progress over context()
+    pending: Optional[int] = None  # sampled but not yet written token
+    done: bool = False
+    admissions: int = 0  # > 1 means the request was preempted and resumed
+
+    def context(self) -> np.ndarray:
+        """Tokens to (re)compute on admission: the prompt plus everything
+        already emitted — after a preemption the resumed prefill re-derives
+        the exact greedy continuation."""
+        return np.concatenate(
+            [self.prompt, np.asarray(self.generated, np.int32)]
+        ).astype(np.int32)
+
+    def output(self) -> np.ndarray:
+        return self.context()
+
+
+def _default_buckets(max_slots: int) -> List[int]:
+    """Powers of two up to and including max_slots."""
+    buckets, b = [], 1
+    while b < max_slots:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_slots)
+    return sorted(set(buckets))
+
+
+class PagedServer:
+    """Owns the page pool, the per-bucket compiled programs, and the
+    admit → prefill-chunk → decode-step loop."""
+
+    def __init__(
+        self,
+        cfg: TransformerConfig,
+        params,
+        page_size: int = 16,
+        num_pages: int = 0,
+        max_slots: int = 8,
+        slot_buckets: Optional[Sequence[int]] = None,
+        max_seq_len: int = 0,
+        prefill_chunk: int = 32,
+        attn_impl: str = "auto",
+        dtype=None,
+        telemetry=None,
+    ):
+        self.cfg = cfg
+        self.params = params
+        self.prefill_chunk = int(prefill_chunk)
+        self.attn_impl = attn_impl
+        self.telemetry = telemetry
+        max_seq = int(max_seq_len or cfg.max_seq_len)
+        if num_pages <= 0:
+            # worst-case sizing: every slot at max length, plus the trash
+            # page — no preemption can ever trigger. Shrink num_pages to
+            # oversubscribe HBM and trade it for preemptions.
+            num_pages = max_slots * (-(-max_seq // page_size)) + 1
+        self.pool = PagePool(
+            cfg, num_pages, page_size, max_slots,
+            max_seq_len=max_seq, dtype=dtype,
+        )
+        buckets = sorted(set(int(b) for b in (slot_buckets or _default_buckets(max_slots))))
+        if buckets[-1] < max_slots:
+            buckets.append(max_slots)
+        if any(b < 1 for b in buckets):
+            raise ValueError(f"slot buckets must be >= 1, got {buckets}")
+        self.buckets = buckets
+        self._queue: deque[Request] = deque()
+        self._active: List[Request] = []  # admission order (oldest first)
+        self._results: Dict[int, np.ndarray] = {}
+        self._next_uid = 0
+        self.stats = {
+            "admitted": 0,
+            "preempted": 0,
+            "finished": 0,
+            "prefill_chunks": 0,
+            "decode_steps": 0,
+        }
+
+    # --- request intake -------------------------------------------------
+    def submit(
+        self,
+        prompt,
+        max_new_tokens: int = 32,
+        eos_token_id: Optional[int] = None,
+    ) -> int:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        total = prompt.size + int(max_new_tokens)
+        if total > self.pool.max_seq_len:
+            raise ValueError(
+                f"prompt {prompt.size} + max_new_tokens {max_new_tokens} exceeds "
+                f"the serving max_seq_len {self.pool.max_seq_len}"
+            )
+        if self.pool.pages_for(total) > self.pool.num_pages - 1:
+            raise ValueError(
+                f"request needs {self.pool.pages_for(total)} pages but the pool "
+                f"holds {self.pool.num_pages - 1} allocatable"
+            )
+        uid = self._next_uid
+        self._next_uid += 1
+        self._queue.append(
+            Request(uid=uid, prompt=prompt, max_new_tokens=int(max_new_tokens),
+                    eos_token_id=eos_token_id)
+        )
+        return uid
+
+    def has_work(self) -> bool:
+        return bool(self._queue or self._active)
+
+    def result(self, uid: int) -> Optional[np.ndarray]:
+        return self._results.get(uid)
+
+    # --- one scheduler iteration ---------------------------------------
+    def step(self) -> None:
+        """Admit what fits, push every pending prefill one chunk, run one
+        decode dispatch over the running set."""
+        self._admit()
+        self._prefill_step()
+        self._decode_step()
+
+    def run(self) -> Dict[int, np.ndarray]:
+        while self.has_work():
+            self.step()
+        return self._results
+
+    def serve(
+        self,
+        prompts: Sequence,
+        max_new_tokens=32,
+        eos_token_id: Optional[int] = None,
+    ) -> List[np.ndarray]:
+        """Submit a batch (scalar or per-request ``max_new_tokens``), run to
+        completion, return outputs in submission order."""
+        if isinstance(max_new_tokens, (int, np.integer)):
+            max_new_tokens = [max_new_tokens] * len(prompts)
+        if len(max_new_tokens) != len(prompts):
+            raise ValueError(
+                f"{len(prompts)} prompts but {len(max_new_tokens)} max_new_tokens"
+            )
+        uids = [
+            self.submit(p, max_new_tokens=int(n), eos_token_id=eos_token_id)
+            for p, n in zip(prompts, max_new_tokens)
+        ]
+        self.run()
+        # pop: the server lives as long as the engine, and a per-batch
+        # serve() loop must not retain every output ever generated
+        return [self._results.pop(u) for u in uids]
+
+    # --- phases ---------------------------------------------------------
+    def _admit(self) -> None:
+        while self._queue:
+            req = self._queue[0]
+            ctx_len = req.prompt.size + len(req.generated)
+            # reserve the whole context plus the first decode write so a
+            # prefill can never die halfway through its own prompt
+            slot = self.pool.alloc_slot(ctx_len + 1)
+            if slot is None:
+                break
+            self._queue.popleft()
+            req.slot = slot
+            req.consumed = 0
+            req.pending = None
+            req.admissions += 1
+            self._active.append(req)
+            self.stats["admitted"] += 1
+
+    def _prefill_step(self) -> None:
+        C = self.prefill_chunk
+        prefill = build_paged_prefill(
+            self.cfg, C, self.pool.page_size, attn_impl=self.attn_impl,
+            telemetry=self.telemetry,
+        )
+        for req in [r for r in self._active if r.pending is None and not r.done]:
+            ctx = req.context()
+            start = req.consumed
+            real = min(C, ctx.size - start)
+            chunk = np.zeros((1, C), np.int32)
+            chunk[0, :real] = ctx[start : start + real]
+            pt, _ = self.pool.rows([req.slot])
+            tok, new_k, new_v = prefill(
+                self.params, chunk, self.pool.cache.k_pages, self.pool.cache.v_pages,
+                pt, np.asarray([start], np.int32), np.int32(real - 1),
+            )
+            self.pool.cache = PagedKVCache(k_pages=new_k, v_pages=new_v)
+            self.pool.advance(req.slot, real)
+            req.consumed = start + real
+            self.stats["prefill_chunks"] += 1
+            if req.consumed == ctx.size:
+                self._emit(req, int(np.asarray(tok)[0]))
+
+    def _decode_step(self) -> None:
+        running = [r for r in self._active if r.pending is not None and not r.done]
+        # grow each running row by one position, preempting the youngest
+        # active request (prefilling or running) when the pool is dry —
+        # vLLM's recompute preemption: the victim's greedy continuation is
+        # re-derived exactly on re-admission
+        idx = 0
+        while idx < len(running):
+            req = running[idx]
+            while not self.pool.ensure(req.slot, int(self.pool.seq_lens[req.slot]) + 1):
+                candidates = [r for r in self._active if r is not req]
+                if not candidates:
+                    # unreachable while submit() validates total size, kept
+                    # as a hard stop against a silent infinite loop
+                    raise RuntimeError(
+                        f"page pool exhausted by a single sequence (len "
+                        f"{int(self.pool.seq_lens[req.slot])}): the pool holds "
+                        f"{self.pool.num_pages - 1} pages x {self.pool.page_size} tokens"
+                    )
+                victim = candidates[-1]  # latest admission
+                self._preempt(victim)
+                if victim in running:
+                    vi = running.index(victim)
+                    running.remove(victim)
+                    if vi < idx:
+                        idx -= 1
+            idx += 1
+        if not running:
+            return
+        bucket = min(b for b in self.buckets if b >= len(running))
+        tokens = np.zeros(bucket, np.int32)
+        page_table = np.full((bucket, self.pool.max_pages_per_slot), -1, np.int32)
+        lengths = np.zeros(bucket, np.int32)
+        rows_pt, rows_len = self.pool.rows([r.slot for r in running])
+        n = len(running)
+        tokens[:n] = [r.pending for r in running]
+        page_table[:n] = rows_pt
+        lengths[:n] = rows_len
+        decode = build_paged_decode_step(
+            self.cfg, bucket, self.pool.page_size, attn_impl=self.attn_impl,
+            telemetry=self.telemetry,
+        )
+        out, new_k, new_v = decode(
+            self.params, tokens, self.pool.cache.k_pages, self.pool.cache.v_pages,
+            page_table, lengths,
+        )
+        self.pool.cache = PagedKVCache(k_pages=new_k, v_pages=new_v)
+        self.stats["decode_steps"] += 1
+        out = np.asarray(out)  # the step's single host fetch: [bucket] tokens
+        for i, req in enumerate(running):
+            self.pool.advance(req.slot, 1)
+            self._emit(req, int(out[i]))
+
+    # --- bookkeeping ----------------------------------------------------
+    def _emit(self, req: Request, token: int) -> None:
+        """Record a newly sampled token and retire the request if it just
+        hit EOS or its budget (the token is included, matching
+        ``decode.generate``'s output contract)."""
+        req.generated.append(token)
+        req.pending = token
+        if (
+            req.eos_token_id is not None and token == req.eos_token_id
+        ) or len(req.generated) >= req.max_new_tokens:
+            self._finish(req)
+
+    def _finish(self, req: Request) -> None:
+        req.done = True
+        self.pool.free_slot(req.slot)
+        req.slot = None
+        self._active.remove(req)
+        self._results[req.uid] = req.output()
+        self.stats["finished"] += 1
+
+    def _preempt(self, req: Request) -> None:
+        self.pool.free_slot(req.slot)
+        req.slot = None
+        req.pending = None
+        req.consumed = 0
+        self._active.remove(req)
+        self._queue.appendleft(req)
+        self.stats["preempted"] += 1

@@ -12,6 +12,10 @@ row's live length entirely (``pl.when``): HBM reads scale with kv_len, not
 cache capacity. Per-batch lengths arrive via scalar prefetch, making the
 kernel ragged — each batch row stops at its own length (the paged/ragged
 attention the reference approximates with masking).
+
+The serving layer reaches the page-table variant (``paged_decode_attention``
+below) through ``ops/transformer/paged_attention.py``, which fronts it with
+an XLA gather fallback and the chunk-prefill attention.
 """
 
 from __future__ import annotations

@@ -1,0 +1,145 @@
+"""Ragged paged decode attention — serving-layer front end.
+
+The serving layer (``inference/kv_pool.py`` + ``inference/scheduler.py``)
+stores every sequence's KV cache as fixed-size pages in one shared pool
+``[num_pages, NKV, page_size, D]`` per layer, addressed through per-sequence
+page tables. This module is the single attention entry point for that
+layout:
+
+* ``paged_decode_attention`` — one generated token per sequence attends over
+  its live pages. Dispatches to the Pallas kernel
+  (``decode_attention._pallas_paged_decode``: the kv grid walks the page
+  table via scalar prefetch, online softmax, GQA groups ride the sublane
+  dim) on TPU, and to a gather-based XLA implementation everywhere else —
+  interpret-mode Pallas inside a per-step serving program would dominate
+  CPU-mesh test time.
+* ``paged_prefill_attention`` — a prompt chunk ``[B, T]`` attends causally
+  over its own pages (prefix + the chunk itself, already scattered in).
+  Pure XLA: chunked prefill is matmul-bound, and the gather touches only
+  the one sequence being prefilled.
+
+GQA is handled by grouping — queries reshape to ``[B, NKV, G, D]`` and each
+kv head's rows are read once — so no path here (kernel or fallback) ever
+materializes an NH-wide copy of the cache the way a ``jnp.repeat`` expansion
+would.
+
+Page-table conventions (shared with ``inference/kv_pool.py``): ids < 0 or
+>= num_pages are sentinels for unallocated slots; they are clamped to page 0
+(the pool's reserved trash page) and their scores masked by the length, so
+padded tables are always safe to read.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.ops.transformer.decode_attention import (
+    NEG_INF,
+    _on_tpu,
+    paged_decode_attention as _pallas_paged_decode,
+)
+
+
+def _scale_or_default(scale: Optional[float], head_dim: int) -> float:
+    return float(scale) if scale is not None else 1.0 / float(np.sqrt(head_dim))
+
+
+def _gather_pages(pages: jnp.ndarray, page_table: jnp.ndarray) -> jnp.ndarray:
+    """[NP, NKV, P, D] pool + [B, MAXP] table -> [B, MAXP*P, NKV, D] linear
+    view (kv position s lives in table slot s // P at offset s % P)."""
+    NP, NKV, P, D = pages.shape
+    B, maxp = page_table.shape
+    pt = jnp.clip(jnp.asarray(page_table, jnp.int32), 0, NP - 1)
+    # [B, MAXP, NKV, P, D] -> [B, MAXP, P, NKV, D] -> [B, S, NKV, D]
+    return pages[pt].transpose(0, 1, 3, 2, 4).reshape(B, maxp * P, NKV, D)
+
+
+def paged_decode_attention_xla(
+    q: jnp.ndarray,  # [B, NH, D]
+    k_pages: jnp.ndarray,  # [NP, NKV, P, D]
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,  # [B, MAXP] int32
+    kv_len,  # [B] int32 live lengths (or scalar)
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Gather-based reference/fallback: linearize each row's pages and run
+    grouped-GQA masked attention. Rows with length 0 return exact zeros
+    (matching the Pallas kernel's empty-accumulator output)."""
+    B, NH, D = q.shape
+    NP, NKV, P, _ = k_pages.shape
+    assert v_pages.shape == k_pages.shape
+    if NH % NKV:
+        raise ValueError(f"query heads {NH} not a multiple of kv heads {NKV}")
+    G = NH // NKV
+    S = page_table.shape[1] * P
+    scale_f = _scale_or_default(scale, D)
+    k = _gather_pages(k_pages, page_table)  # [B, S, NKV, D]
+    v = _gather_pages(v_pages, page_table)
+    lens = jnp.broadcast_to(jnp.asarray(kv_len, jnp.int32), (B,))
+    qg = q.reshape(B, NKV, G, D)
+    scores = jnp.einsum("bkgd,bskd->bkgs", qg, k).astype(jnp.float32) * scale_f
+    kv_pos = jnp.arange(S, dtype=jnp.int32)
+    live = kv_pos[None, None, None, :] < lens[:, None, None, None]
+    scores = jnp.where(live, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bkgs,bskd->bkgd", probs, v)
+    out = jnp.where((lens > 0)[:, None, None, None], out, 0)
+    return out.reshape(B, NH, D)
+
+
+def paged_decode_attention(
+    q: jnp.ndarray,  # [B, NH, D]
+    k_pages: jnp.ndarray,  # [NP, NKV, P, D]
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,  # [B, MAXP] int32
+    kv_len,  # [B] int32 live lengths
+    scale: Optional[float] = None,
+    impl: str = "auto",
+) -> jnp.ndarray:
+    """Single-token paged attention. ``impl``: ``auto`` picks the Pallas
+    kernel on TPU and the XLA gather fallback elsewhere; ``pallas`` / ``xla``
+    force one (``pallas`` off-TPU runs in interpret mode — tests only)."""
+    if impl == "auto":
+        impl = "pallas" if _on_tpu() else "xla"
+    if impl == "pallas":
+        return _pallas_paged_decode(q, k_pages, v_pages, page_table, kv_len, scale=scale)
+    if impl == "xla":
+        return paged_decode_attention_xla(q, k_pages, v_pages, page_table, kv_len, scale=scale)
+    raise ValueError(f"unknown paged attention impl {impl!r}; expected auto|pallas|xla")
+
+
+def paged_prefill_attention(
+    q: jnp.ndarray,  # [B, T, NH, D] — a prompt chunk's queries
+    k_pages: jnp.ndarray,  # [NP, NKV, P, D]
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,  # [B, MAXP] int32
+    q_positions: jnp.ndarray,  # [B, T] absolute positions of the chunk tokens
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Causal chunk attention over the sequence's own pages: query at
+    absolute position p sees kv positions <= p (the chunk's k/v have already
+    been scattered into the pages, so the chunk attends to itself too).
+    Positions past a chunk's real end (pad tail) produce garbage rows the
+    caller ignores — their writes land on the trash page and their reads are
+    causally bounded, so they never contaminate live positions."""
+    B, T, NH, D = q.shape
+    NP, NKV, P, _ = k_pages.shape
+    if NH % NKV:
+        raise ValueError(f"query heads {NH} not a multiple of kv heads {NKV}")
+    G = NH // NKV
+    S = page_table.shape[1] * P
+    scale_f = _scale_or_default(scale, D)
+    k = _gather_pages(k_pages, page_table)  # [B, S, NKV, D]
+    v = _gather_pages(v_pages, page_table)
+    qg = q.reshape(B, T, NKV, G, D)
+    scores = jnp.einsum("btkgd,bskd->bkgts", qg, k).astype(jnp.float32) * scale_f
+    kv_pos = jnp.arange(S, dtype=jnp.int32)
+    causal = q_positions[:, None, None, :, None] >= kv_pos[None, None, None, None, :]
+    scores = jnp.where(causal, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bkgts,bskd->btkgd", probs, v)
+    return out.reshape(B, T, NH, D)

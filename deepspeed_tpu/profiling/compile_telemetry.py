@@ -24,6 +24,7 @@ and explicit executable release keep working through it.
 
 from __future__ import annotations
 
+import itertools
 import os
 import time
 from dataclasses import dataclass, field
@@ -115,8 +116,14 @@ class InstrumentedFunction:
 class CompileTelemetry:
     """Registry of named instrumented programs (one per engine)."""
 
+    _uids = itertools.count()
+
     def __init__(self):
         self._programs: Dict[str, ProgramStats] = {}
+        # process-unique, never-recycled id: module-level program caches
+        # (inference/decode.py) key compiled callables on it — ``id(self)``
+        # could alias a dead registry at a recycled address
+        self.uid = next(CompileTelemetry._uids)
 
     def instrument(self, name: str, fn: Callable, **jit_kwargs) -> InstrumentedFunction:
         """``jax.jit(fn, **jit_kwargs)`` with counters under ``name``.

@@ -1,0 +1,146 @@
+"""Block-pool KV cache allocator tests (``inference/kv_pool.py``).
+
+The pool is the serving layer's memory manager: pages must never be
+double-booked, the trash page must never circulate, failed growth must be
+all-or-nothing, and defrag must move bytes without changing what any
+sequence reads back.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.kv_pool import TRASH_PAGE, PagePool, init_paged_cache
+from deepspeed_tpu.models import llama_config
+
+
+def _pool(num_pages=10, page_size=4, max_slots=3, max_seq_len=32):
+    cfg = llama_config("tiny", num_layers=2, max_seq_len=max_seq_len)
+    return PagePool(
+        cfg, num_pages=num_pages, page_size=page_size, max_slots=max_slots,
+        max_seq_len=max_seq_len, dtype=jnp.float32,
+    )
+
+
+def test_alloc_free_accounting():
+    pool = _pool()
+    assert pool.free_pages() == 9  # page 0 reserved
+    assert pool.used_pages() == 0
+    slot = pool.alloc_slot(6)  # 6 tokens @ page_size 4 -> 2 pages
+    assert slot is not None
+    assert pool.free_pages() == 7 and pool.used_pages() == 2
+    owned = set(int(p) for p in pool.page_table[slot] if p >= 0)
+    assert len(owned) == 2 and TRASH_PAGE not in owned
+    pool.advance(slot, 6)
+    assert pool.live_tokens() == 6
+    assert pool.utilization() == pytest.approx(6 / 8)
+    returned = pool.free_slot(slot)
+    assert returned == 2
+    assert pool.free_pages() == 9 and pool.live_tokens() == 0
+    assert (pool.page_table[slot] == -1).all()
+
+
+def test_pages_are_exclusive_across_slots():
+    pool = _pool()
+    s1 = pool.alloc_slot(8)
+    s2 = pool.alloc_slot(8)
+    own1 = {int(p) for p in pool.page_table[s1] if p >= 0}
+    own2 = {int(p) for p in pool.page_table[s2] if p >= 0}
+    assert own1.isdisjoint(own2)
+    assert TRASH_PAGE not in own1 | own2
+
+
+def test_ensure_is_all_or_nothing():
+    pool = _pool(num_pages=6, page_size=4)  # 5 allocatable pages
+    slot = pool.alloc_slot(16)  # takes 4 pages
+    free_before = pool.free_pages()
+    assert free_before == 1
+    # growing to 28 tokens needs 7 pages total (+3): must fail AND leave the
+    # single free page untouched
+    assert not pool.ensure(slot, 28)
+    assert pool.free_pages() == free_before
+    assert pool.ensure(slot, 20)  # +1 page fits
+    assert pool.free_pages() == 0
+
+
+def test_admission_gating():
+    pool = _pool(num_pages=6, page_size=4, max_slots=2)
+    assert pool.can_admit(8)
+    s1 = pool.alloc_slot(16)  # 4 of 5 pages
+    assert s1 is not None
+    assert not pool.can_admit(8)  # needs 2 pages, 1 free
+    assert pool.alloc_slot(8) is None
+    assert pool.can_admit(4)  # 1 page fits
+    # a slot-exhausted pool refuses even tiny requests
+    s2 = pool.alloc_slot(2)
+    assert s2 is not None and pool.alloc_slot(1) is None
+
+
+def test_max_seq_len_is_enforced():
+    pool = _pool(max_seq_len=8, page_size=4, num_pages=10)
+    slot = pool.alloc_slot(8)
+    assert not pool.ensure(slot, 9)
+    with pytest.raises(AssertionError):
+        pool.advance(slot, 9)
+
+
+def test_defrag_preserves_contents_and_compacts():
+    pool = _pool(num_pages=10, page_size=4)
+    s1 = pool.alloc_slot(8)
+    s2 = pool.alloc_slot(8)
+    # stamp every owned page with a recognizable value
+    k = pool.cache.k_pages
+    stamps = {}
+    for s in (s1, s2):
+        for pid in pool.page_table[s]:
+            if pid >= 0:
+                k = k.at[:, int(pid)].set(float(pid))
+                stamps[(s, int(pid))] = float(pid)
+    pool.cache = pool.cache._replace(k_pages=k)
+    # free s1 -> holes below s2's pages; defrag must close them
+    pool.free_slot(s1)
+    before = {
+        i: float(np.asarray(pool.cache.k_pages[0, int(pid), 0, 0, 0]))
+        for i, pid in enumerate(pool.page_table[s2]) if pid >= 0
+    }
+    moves = pool.defrag()
+    live = [int(p) for p in pool.page_table[s2] if p >= 0]
+    assert sorted(live) == [1, 2]  # densest prefix after the trash page
+    after = {
+        i: float(np.asarray(pool.cache.k_pages[0, int(pid), 0, 0, 0]))
+        for i, pid in enumerate(pool.page_table[s2]) if pid >= 0
+    }
+    assert after == before  # same bytes visible through the table
+    assert moves >= 1
+    # free list must cover exactly the non-live, non-trash pages
+    assert pool.free_pages() == 9 - 2
+    assert pool.defrag() == 0  # already compact
+
+
+def test_hbm_formula():
+    cfg = llama_config("tiny", num_layers=2, max_seq_len=32)
+    pool = _pool(num_pages=10, page_size=4)
+    cache = init_paged_cache(cfg, num_pages=10, page_size=4, dtype=jnp.float32)
+    per_token = 2 * cfg.num_layers * cfg.num_kv_heads * cfg.head_dim * 4
+    assert cache.bytes_per_token == per_token
+    assert cache.hbm_bytes() == 10 * 4 * per_token
+    slot = pool.alloc_slot(6)
+    pool.advance(slot, 6)
+    # live HBM counts allocated pages (page-granular), not raw tokens
+    assert pool.live_hbm_bytes() == 2 * 4 * per_token
+
+
+def test_rows_returns_copies():
+    pool = _pool()
+    slot = pool.alloc_slot(4)
+    pt, lens = pool.rows([slot])
+    pt[0, 0] = -7
+    assert pool.page_table[slot, 0] != -7
+
+
+def test_reject_degenerate_pools():
+    cfg = llama_config("tiny", num_layers=2, max_seq_len=32)
+    with pytest.raises(ValueError, match="reserved"):
+        PagePool(cfg, num_pages=1, page_size=4, max_slots=1)

@@ -1,0 +1,248 @@
+"""Continuous-batching paged-KV serving tests.
+
+Load-bearing checks (ISSUE 2 acceptance): paged greedy decode is
+token-exact against the dense lockstep ``decode.generate`` across varying
+occupancy, mid-stream admission, and eviction/preemption; and over a
+3-wave admit/finish/admit schedule the compile telemetry shows ≤1 compile
+per shape bucket and exactly one ``paged_decode_*`` dispatch per decode
+step.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.inference import decode
+from deepspeed_tpu.inference.scheduler import PagedServer
+from deepspeed_tpu.models import TransformerLM
+from deepspeed_tpu.models.config import TransformerConfig
+from deepspeed_tpu.profiling.compile_telemetry import CompileTelemetry
+
+CFG = dict(
+    vocab_size=128,
+    hidden_size=64,
+    num_layers=2,
+    num_heads=4,
+    num_kv_heads=2,  # GQA on the serving path
+    max_seq_len=64,
+    norm="rmsnorm",
+    position="rope",
+    activation="swiglu",
+    use_bias=False,
+    tie_embeddings=False,
+    flash_attention=False,
+    dtype="float32",
+)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    cfg = TransformerConfig(**CFG)
+    model = TransformerLM(cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0, cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(0), toks)
+    return cfg, model, params
+
+
+def _prompts(n, seed=0, lo=3, hi=20):
+    rs = np.random.RandomState(seed)
+    return [
+        rs.randint(0, CFG["vocab_size"], (int(rs.randint(lo, hi)),)).astype(np.int32)
+        for _ in range(n)
+    ]
+
+
+def _dense(cfg, params, prompt, n, eos=None):
+    return np.asarray(decode.generate(cfg, params, prompt[None], n, eos_token_id=eos))[0]
+
+
+def _server(cfg, params, **kw):
+    kw.setdefault("page_size", 8)
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("prefill_chunk", 8)
+    kw.setdefault("attn_impl", "xla")
+    kw.setdefault("dtype", jnp.float32)
+    return PagedServer(cfg, params, **kw)
+
+
+def test_paged_matches_dense_varying_occupancy(model_and_params):
+    """More requests than slots, ragged prompt lengths, ragged budgets:
+    every output must equal the request's standalone dense greedy decode."""
+    cfg, _, params = model_and_params
+    server = _server(cfg, params)
+    prompts = _prompts(6, seed=2)
+    budgets = [10, 3, 7, 12, 1, 5]
+    outs = server.serve(prompts, max_new_tokens=budgets)
+    for p, n, out in zip(prompts, budgets, outs):
+        np.testing.assert_array_equal(out, _dense(cfg, params, p, n))
+    assert server.stats["finished"] == 6
+    # occupancy varied: 6 requests through 4 slots means a second wave
+    assert server.stats["admitted"] == 6
+    # pool fully drained once everything finished
+    assert server.pool.used_pages() == 0 and server.pool.live_tokens() == 0
+
+
+def test_admission_mid_stream(model_and_params):
+    """Requests submitted while others are mid-decode join the running
+    batch without disturbing in-flight sequences."""
+    cfg, _, params = model_and_params
+    server = _server(cfg, params)
+    prompts = _prompts(4, seed=3)
+    first = [server.submit(p, max_new_tokens=12) for p in prompts[:2]]
+    for _ in range(4):  # prefill + a few decode steps for wave 1
+        server.step()
+    assert server.stats["decode_steps"] >= 2
+    late = [server.submit(p, max_new_tokens=12) for p in prompts[2:]]
+    results = server.run()
+    for uid, p in zip(first + late, prompts):
+        np.testing.assert_array_equal(results[uid], _dense(cfg, params, p, 12))
+
+
+def test_eviction_preemption_is_token_exact(model_and_params):
+    """An undersized pool forces preemption mid-stream; recompute on
+    re-admission must reproduce the exact greedy continuation."""
+    cfg, _, params = model_and_params
+    server = _server(
+        cfg, params, page_size=4, num_pages=14, max_slots=3, prefill_chunk=8
+    )
+    prompts = _prompts(4, seed=4, lo=6, hi=14)
+    outs = server.serve(prompts, max_new_tokens=12)
+    assert server.stats["preempted"] >= 1, "pool was sized to force preemption"
+    for p, out in zip(prompts, outs):
+        np.testing.assert_array_equal(out, _dense(cfg, params, p, 12))
+
+
+def test_eos_finishes_request_early(model_and_params):
+    cfg, _, params = model_and_params
+    server = _server(cfg, params)
+    prompts = _prompts(2, seed=5)
+    # derive each prompt's first greedy token and use row 0's as "EOS"
+    probe = _dense(cfg, params, prompts[0], 1)
+    eos = int(probe[-1])
+    outs = server.serve(prompts, max_new_tokens=10, eos_token_id=eos)
+    for p, out in zip(prompts, outs):
+        np.testing.assert_array_equal(out, _dense(cfg, params, p, 10, eos=eos))
+    # row 0 emitted eos immediately: prompt + the single eos token
+    assert outs[0].size == prompts[0].size + 1 and outs[0][-1] == eos
+
+
+def test_retrace_guard_and_single_dispatch_per_step(model_and_params):
+    """3-wave admit/finish/admit schedule: ≤1 compile per shape bucket,
+    exactly one paged_decode dispatch per decode step, and every prompt
+    chunk through ONE compiled prefill program."""
+    cfg, _, params = model_and_params
+    telemetry = CompileTelemetry()
+    server = _server(cfg, params, max_slots=4, telemetry=telemetry)
+    waves = [_prompts(2, seed=6), _prompts(4, seed=7), _prompts(2, seed=8)]
+    for wave in waves:
+        outs = server.serve(wave, max_new_tokens=6)
+        for p, out in zip(wave, outs):
+            np.testing.assert_array_equal(out, _dense(cfg, params, p, 6))
+    stats = telemetry.stats()
+    paged = {k: v for k, v in stats.items() if k.startswith("paged_")}
+    assert paged, f"no paged programs instrumented: {list(stats)}"
+    for name, rec in paged.items():
+        assert rec["compiles"] <= 1, f"{name} recompiled: {rec}"
+    decode_dispatches = sum(
+        rec["dispatches"] for name, rec in stats.items()
+        if name.startswith("paged_decode_")
+    )
+    assert decode_dispatches == server.stats["decode_steps"]
+    prefill_dispatches = sum(
+        rec["dispatches"] for name, rec in stats.items()
+        if name.startswith("paged_prefill_")
+    )
+    assert prefill_dispatches == server.stats["prefill_chunks"]
+    # bucketed shapes: program count bounded by the bucket set, not traffic
+    assert len(paged) <= len(server.buckets) + 1
+
+
+def test_engine_serve_and_compile_stats(model_and_params):
+    """The engine-level surface: paged_kv config knobs, serve(), and the
+    inference compile_stats() satellite (forward + decode loop programs)."""
+    cfg, model, params = model_and_params
+    engine = ds.init_inference(
+        model,
+        dtype="fp32",
+        paged_kv={"page_size": 8, "max_slots": 4, "prefill_chunk": 8, "attn_impl": "xla"},
+    )
+    engine.set_params(params)
+    engine._ds_config = cfg  # converted-family contract (containers set this)
+    prompts = _prompts(3, seed=9)
+    outs = engine.serve(prompts, max_new_tokens=6)
+    for p, out in zip(prompts, outs):
+        np.testing.assert_array_equal(out, _dense(cfg, params, p, 6))
+    stats = engine.compile_stats()
+    assert any(k.startswith("paged_decode_") for k in stats)
+    sstats = engine.serve_stats()
+    assert sstats["finished"] == 3 and sstats["decode_steps"] >= 1
+    # acceptance: exactly one paged_decode dispatch per decode step,
+    # observed through the engine's own compile_stats()
+    assert sum(
+        rec["dispatches"] for name, rec in stats.items()
+        if name.startswith("paged_decode_")
+    ) == sstats["decode_steps"]
+    # satellite: the jitted forward and the kv decode loop are instrumented
+    toks = jnp.asarray(np.stack([np.resize(prompts[0], 8)]))
+    engine(toks)
+    engine.generate(toks, max_new_tokens=4)
+    stats = engine.compile_stats()
+    assert stats["forward"]["dispatches"] >= 1
+    assert "kv_prefill" in stats and "kv_decode_loop" in stats
+    assert stats["kv_decode_loop"]["compiles"] <= 1
+
+
+def test_paged_matches_dense_gpt2_family():
+    """Learned positions + tied embeddings + MHA (the gpt2 shape) through
+    the paged path — per-row position gathers must stay exact."""
+    from deepspeed_tpu.models.config import gpt2_config
+
+    cfg = gpt2_config(
+        "tiny", num_layers=2, max_seq_len=64, flash_attention=False,
+        dtype="float32", vocab_size=128, hidden_size=64, num_heads=4,
+    )
+    model = TransformerLM(cfg)
+    rs = np.random.RandomState(11)
+    prompts = [rs.randint(0, cfg.vocab_size, (n,)).astype(np.int32) for n in (4, 11)]
+    params = model.init(jax.random.PRNGKey(0), jnp.asarray(prompts[0][None]))
+    server = PagedServer(
+        cfg, params, page_size=8, max_slots=2, prefill_chunk=8,
+        attn_impl="xla", dtype=jnp.float32,
+    )
+    outs = server.serve(prompts, max_new_tokens=5)
+    for p, out in zip(prompts, outs):
+        np.testing.assert_array_equal(out, _dense(cfg, params, p, 5))
+
+
+def test_prefill_chunk_one_and_results_drain(model_and_params):
+    """prefill_chunk=1 must take the causal prefill path (its T==1 programs
+    are chunks, not decode steps), and serve() must drain its results so a
+    long-lived server never accumulates past outputs."""
+    cfg, _, params = model_and_params
+    server = _server(cfg, params, max_slots=1, prefill_chunk=1)
+    prompts = _prompts(2, seed=12, lo=2, hi=4)
+    outs = server.serve(prompts, max_new_tokens=2)
+    for p, out in zip(prompts, outs):
+        np.testing.assert_array_equal(out, _dense(cfg, params, p, 2))
+    assert server._results == {}  # drained by serve()
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        server.serve(prompts, max_new_tokens=[2])
+
+
+def test_serve_rejects_oversized_requests(model_and_params):
+    cfg, _, params = model_and_params
+    server = _server(cfg, params)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        server.submit(np.zeros(60, np.int32), max_new_tokens=10)
+    # a request that could never fit the pool is rejected at submit, not
+    # discovered by an unfixable preemption loop mid-stream
+    tiny = PagedServer(
+        cfg, params, page_size=4, num_pages=3, max_slots=2,
+        prefill_chunk=8, attn_impl="xla", dtype=jnp.float32, max_seq_len=64,
+    )
+    with pytest.raises(ValueError, match="pages"):
+        tiny.submit(np.zeros(4, np.int32), max_new_tokens=20)

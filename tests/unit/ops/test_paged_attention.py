@@ -1,0 +1,195 @@
+"""Paged attention front-end tests (``ops/transformer/paged_attention.py``).
+
+The serving layer depends on three invariants: the XLA gather fallback and
+the Pallas page-table kernel agree, sentinel/garbage table entries past the
+live length never leak into outputs, and GQA is computed by grouping —
+never by materializing an NH-wide cache copy.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.transformer.paged_attention import (
+    paged_decode_attention,
+    paged_decode_attention_xla,
+    paged_prefill_attention,
+)
+
+
+def _rand_pool(rs, NP, NKV, P, D):
+    k = rs.randn(NP, NKV, P, D).astype(np.float32)
+    v = rs.randn(NP, NKV, P, D).astype(np.float32)
+    return jnp.asarray(k), jnp.asarray(v)
+
+
+def _dense_from_pages(k_pages, page_table, P):
+    """[B, S, NKV, D] linear cache equivalent of a page table (numpy ref)."""
+    kp = np.asarray(k_pages)
+    pt = np.asarray(page_table)
+    B, maxp = pt.shape
+    _, NKV, _, D = kp.shape
+    out = np.zeros((B, maxp * P, NKV, D), np.float32)
+    for b in range(B):
+        for i, pid in enumerate(pt[b]):
+            if pid >= 0:
+                out[b, i * P : (i + 1) * P] = kp[pid].transpose(1, 0, 2)
+    return out
+
+
+def _ref_decode(q, k_lin, v_lin, lens, scale):
+    B, NH, D = q.shape
+    NKV = k_lin.shape[2]
+    G = NH // NKV
+    out = np.zeros((B, NH, D), np.float32)
+    for b in range(B):
+        L = int(lens[b])
+        if L == 0:
+            continue
+        for h in range(NH):
+            kv = h // G
+            s = (k_lin[b, :L, kv] @ q[b, h]) * scale
+            p = np.exp(s - s.max())
+            p /= p.sum()
+            out[b, h] = p @ v_lin[b, :L, kv]
+    return out
+
+
+@pytest.mark.parametrize("nkv", [4, 2, 1])  # MHA, GQA, MQA
+def test_xla_fallback_matches_reference(nkv):
+    B, NH, D, P, NP, maxp = 3, 4, 16, 8, 12, 4
+    rs = np.random.RandomState(0)
+    q = rs.randn(B, NH, D).astype(np.float32)
+    kp, vp = _rand_pool(rs, NP, nkv, P, D)
+    # ragged tables: unused tail entries are -1 sentinels
+    pt = np.full((B, maxp), -1, np.int32)
+    pt[0, :3] = [3, 7, 1]
+    pt[1, :1] = [5]
+    pt[2, :4] = [2, 9, 4, 8]
+    lens = np.array([20, 8, 32], np.int32)
+    out = paged_decode_attention_xla(jnp.asarray(q), kp, vp, jnp.asarray(pt), lens)
+    ref = _ref_decode(
+        q, _dense_from_pages(kp, pt, P), _dense_from_pages(vp, pt, P),
+        lens, 1.0 / np.sqrt(D),
+    )
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5, atol=2e-5)
+
+
+def test_xla_matches_pallas_interpret():
+    B, NH, nkv, D, P, NP, maxp = 2, 4, 2, 16, 8, 10, 3
+    rs = np.random.RandomState(1)
+    q = jnp.asarray(rs.randn(B, NH, D).astype(np.float32))
+    kp, vp = _rand_pool(rs, NP, nkv, P, D)
+    pt = np.full((B, maxp), -1, np.int32)
+    pt[0, :2] = [4, 2]
+    pt[1, :3] = [7, 1, 9]
+    lens = np.array([13, 24], np.int32)
+    out_x = paged_decode_attention(q, kp, vp, jnp.asarray(pt), lens, impl="xla")
+    out_p = paged_decode_attention(q, kp, vp, jnp.asarray(pt), lens, impl="pallas")
+    np.testing.assert_allclose(np.asarray(out_x), np.asarray(out_p), rtol=2e-5, atol=2e-5)
+
+
+def test_zero_length_rows_and_garbage_pages_are_inert():
+    B, NH, nkv, D, P, NP, maxp = 2, 2, 2, 8, 4, 6, 2
+    rs = np.random.RandomState(2)
+    q = jnp.asarray(rs.randn(B, NH, D).astype(np.float32))
+    kp, vp = _rand_pool(rs, NP, nkv, P, D)
+    pt = np.array([[3, -1], [-1, -1]], np.int32)
+    lens = np.array([4, 0], np.int32)
+    out = np.asarray(paged_decode_attention_xla(q, kp, vp, jnp.asarray(pt), lens))
+    assert (out[1] == 0).all()  # dead row: exact zeros (kernel contract)
+    # garbage in pages past the live length must not move the output
+    kp2 = kp.at[5].set(1e6)
+    vp2 = vp.at[5].set(-1e6)
+    out2 = np.asarray(paged_decode_attention_xla(q, kp2, vp2, jnp.asarray(pt), lens))
+    np.testing.assert_allclose(out, out2, rtol=1e-6)
+
+
+def test_prefill_chunk_matches_causal_reference():
+    B, T, NH, nkv, D, P, NP, maxp = 1, 6, 4, 2, 8, 4, 8, 4
+    rs = np.random.RandomState(3)
+    q = rs.randn(B, T, NH, D).astype(np.float32)
+    kp, vp = _rand_pool(rs, NP, nkv, P, D)
+    pt = np.array([[2, 5, 1, -1]], np.int32)
+    start = 3  # chunk positions 3..8: prefix 0..2 already in the pages
+    q_pos = np.arange(start, start + T, dtype=np.int32)[None]
+    out = paged_prefill_attention(
+        jnp.asarray(q), kp, vp, jnp.asarray(pt), jnp.asarray(q_pos)
+    )
+    k_lin = _dense_from_pages(kp, pt, P)
+    v_lin = _dense_from_pages(vp, pt, P)
+    scale = 1.0 / np.sqrt(D)
+    for t in range(T):
+        ref = _ref_decode(
+            q[:, t], k_lin, v_lin, np.array([start + t + 1]), scale
+        )
+        np.testing.assert_allclose(
+            np.asarray(out[:, t]), ref, rtol=2e-5, atol=2e-5,
+            err_msg=f"chunk offset {t}",
+        )
+
+
+def test_gqa_grouped_equals_repeat_expansion():
+    """The grouped-einsum GQA math must equal the (banned) NH-wide repeat."""
+    B, NH, nkv, D, P, NP, maxp = 2, 8, 2, 16, 8, 8, 2
+    rs = np.random.RandomState(4)
+    q = rs.randn(B, NH, D).astype(np.float32)
+    kp, vp = _rand_pool(rs, NP, nkv, P, D)
+    pt = np.array([[1, 4], [6, -1]], np.int32)
+    lens = np.array([12, 5], np.int32)
+    out = paged_decode_attention_xla(jnp.asarray(q), kp, vp, jnp.asarray(pt), lens)
+    # reference: expand kv to NH heads, per-head attention
+    k_lin = _dense_from_pages(kp, pt, P).repeat(NH // nkv, axis=2)
+    v_lin = _dense_from_pages(vp, pt, P).repeat(NH // nkv, axis=2)
+    ref = np.zeros((B, NH, D), np.float32)
+    scale = 1.0 / np.sqrt(D)
+    for b in range(B):
+        for h in range(NH):
+            s = (k_lin[b, : lens[b], h] @ q[b, h]) * scale
+            p = np.exp(s - s.max())
+            p /= p.sum()
+            ref[b, h] = p @ v_lin[b, : lens[b], h]
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5, atol=2e-5)
+
+
+def test_dense_fallback_gqa_has_no_repeat():
+    """decode.py's dense GQA fallback: grouped einsum matches the repeat
+    reference, and the lowered HLO contains no NH-wide cache broadcast
+    (satellite guard for the jnp.repeat blowup fix)."""
+    from deepspeed_tpu.inference.decode import _cached_attention
+    from deepspeed_tpu.models.config import TransformerConfig
+
+    cfg = TransformerConfig(
+        vocab_size=64, hidden_size=64, num_layers=1, num_heads=8,
+        num_kv_heads=2, max_seq_len=32, flash_attention=False, dtype="float32",
+    )
+    B, T, S = 2, 3, 17  # S deliberately not a multiple of 256 (dense path)
+    rs = np.random.RandomState(5)
+    q = jnp.asarray(rs.randn(B, T, 8, 8).astype(np.float32))
+    k = jnp.asarray(rs.randn(B, S, 2, 8).astype(np.float32))
+    v = jnp.asarray(rs.randn(B, S, 2, 8).astype(np.float32))
+    q_pos = jnp.asarray(np.tile(np.arange(5, 5 + T, dtype=np.int32), (B, 1)))
+    mask = jnp.asarray(np.arange(S) < 8)
+    out = _cached_attention(cfg, q, k, v, q_pos, mask)
+    # repeat-based reference
+    kr = jnp.repeat(k, 4, axis=2)
+    vr = jnp.repeat(v, 4, axis=2)
+    scores = jnp.einsum("btnd,bsnd->bnts", q, kr).astype(jnp.float32) / np.sqrt(8)
+    causal = q_pos[:, None, :, None] >= jnp.arange(S)[None, None, None, :]
+    scores = jnp.where(causal & mask[None, None, None, :], scores, -1e30)
+    ref = jnp.einsum("bnts,bsnd->btnd", jax.nn.softmax(scores, -1), vr)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+    # structural guard: no intermediate may materialize an NH-wide cache
+    # copy [B, S, NH, D] (what jnp.repeat(k_cache, G, axis=2) produced)
+    jaxpr = jax.make_jaxpr(
+        lambda q, k, v: _cached_attention(cfg, q, k, v, q_pos, mask)
+    )(q, k, v)
+    banned = (B, S, 8, 8)
+    for eqn in jaxpr.jaxpr.eqns:
+        for var in eqn.outvars:
+            assert tuple(getattr(var.aval, "shape", ())) != banned, (
+                f"decode fallback materializes an NH-wide cache: {eqn.primitive}"
+            )

@@ -7,7 +7,9 @@
 # (1/2/3/offload/zero++), mesh/groups, collectives, op-builder registry,
 # MoQ, and compression. Run the FULL suite (python -m pytest tests/ -q)
 # before shipping cross-cutting changes; this tier is the per-commit loop.
-# Measured 2026-07-31: ~5 min, 195 tests (+22 fused/telemetry 2026-08-03).
+# Measured 2026-07-31: ~5 min, 195 tests (+22 fused/telemetry 2026-08-03,
+# +24 paged-KV serving 2026-08-03: pool allocator, paged attention parity,
+# continuous-batching vs dense token-exactness + retrace/dispatch guards).
 cd "$(dirname "$0")/.." || exit 1
 exec python -m pytest -q \
   tests/unit/runtime/test_engine.py \
@@ -19,6 +21,9 @@ exec python -m pytest -q \
   tests/unit/runtime/test_runtime_utils.py \
   tests/unit/runtime/test_moq.py \
   tests/unit/runtime/zero \
+  tests/unit/inference/test_kv_pool.py \
+  tests/unit/inference/test_serving.py \
+  tests/unit/ops/test_paged_attention.py \
   tests/unit/ops/test_op_builder.py \
   tests/unit/parallel/test_mesh.py \
   tests/unit/utils/test_groups.py \
