@@ -170,6 +170,34 @@ def _compile_fields(engine):
     }
 
 
+def _analysis_fields(engine):
+    """Static-analysis summary for the result record: the per-config comms
+    budget (collective op count + per-device payload bytes, summed over the
+    dispatched hot programs) and the donation-verified flag, derived from
+    the compiled HLO by ``engine.analysis_report()``. BENCH_r*.json then
+    tracks the communication schedule alongside throughput — a perf PR
+    that silently adds an all-gather or drops a buffer alias shows up in
+    the record even when the wall clock is too noisy to catch it. Runs
+    after the timed window (it re-traces + re-compiles each program once)."""
+    try:
+        rep = engine.analysis_report(
+            passes=["donation", "collectives", "host_transfer"]
+        )
+        t = rep["totals"]
+        return {
+            "static_collective_ops": int(t.get("collective_count", 0)),
+            "static_collective_bytes": int(t.get("collective_bytes", 0)),
+            "donation_verified": bool(t.get("donation_verified", False)),
+            "analysis_violations": int(t.get("violations", 0)),
+        }
+    except Exception as e:
+        # never fail a bench record over analysis, but never vanish
+        # silently either: the missing-fields case must be distinguishable
+        # from "analysis ran clean" in the BENCH files
+        traceback.print_exc()
+        return {"analysis_error": f"{type(e).__name__}: {e}"[:200]}
+
+
 def _timed_steps(engine, batch, warmup=3, steps=20):
     """Place the batch once (a real input pipeline prefetches to device;
     re-uploading identical tokens every step would measure the host link,
@@ -228,6 +256,7 @@ def bench_gpt2_zero1():
         "vs_baseline": round(mfu / NORTH_STAR_MFU, 4),
     }
     rec.update(_compile_fields(engine))
+    rec.update(_analysis_fields(engine))
     return rec
 
 
@@ -280,6 +309,7 @@ def bench_llama_zero3():
         "vs_baseline": round(mfu / NORTH_STAR_MFU, 4),
     }
     rec.update(_compile_fields(engine))
+    rec.update(_analysis_fields(engine))
     return rec
 
 
@@ -326,12 +356,14 @@ def bench_infinity_max_params():
     step_s = time.perf_counter() - t0
     assert np.isfinite(float(loss)), "non-finite streamed loss"
     n_params = engine.num_parameters()
-    return {
+    rec = {
         "metric": METRICS["infinity"],
         "value": int(n_params),
         "unit": f"params (1 step {step_s:.1f}s, loss {float(loss):.3f})",
         "vs_baseline": round(n_params / 1.0e9, 2),
     }
+    rec.update(_analysis_fields(engine))
+    return rec
 
 
 def bench_long_seq():
@@ -381,6 +413,7 @@ def bench_long_seq():
         "vs_baseline": round(mfu / NORTH_STAR_MFU, 4),
     }
     rec.update(_compile_fields(engine))
+    rec.update(_analysis_fields(engine))
     return rec
 
 
@@ -423,18 +456,23 @@ def bench_moe_inference():
         for _ in range(reps):
             out = engine(toks)
         jax.device_get(np.asarray(out[0, -1, :8]))
-        return reps * B * seq / (time.perf_counter() - t0)
+        return reps * B * seq / (time.perf_counter() - t0), engine
 
-    moe_tps = prefill_tps(
+    moe_tps, moe_engine = prefill_tps(
         MoETransformerLM(MoETransformerConfig(num_experts=8, moe_top_k=1, **base))
     )
-    dense_tps = prefill_tps(TransformerLM(TransformerConfig(**base)))
-    return {
+    # analysis snapshot from the MoE engine (the measured object), before
+    # the dense baseline rebuilds the topology
+    analysis_fields = _analysis_fields(moe_engine)
+    dense_tps, _ = prefill_tps(TransformerLM(TransformerConfig(**base)))
+    rec = {
         "metric": METRICS["moe_inference"],
         "value": round(moe_tps, 1),
         "unit": "tokens/s",
         "vs_baseline": round(moe_tps / dense_tps, 4),
     }
+    rec.update(analysis_fields)
+    return rec
 
 
 def bench_decode_serving():
@@ -490,9 +528,10 @@ def bench_decode_serving():
 
     timed_serve()  # compile every bucket/chunk program
     paged_tps = timed_serve()
-    # snapshot BEFORE the dense baseline runs: the record's compile fields
-    # must describe the paged serving programs, not kv_prefill/kv_decode_loop
+    # snapshot BEFORE the dense baseline runs: the record's compile/analysis
+    # fields must describe the paged serving programs, not kv_decode_loop
     compile_fields = _compile_fields(engine)
+    compile_fields.update(_analysis_fields(engine))
 
     def timed_dense():
         t0 = _time.perf_counter()

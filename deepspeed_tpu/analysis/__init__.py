@@ -1,0 +1,39 @@
+"""Static program analysis for the engine's compiled programs + repo source.
+
+Two layers:
+
+* **Program passes** (``passes.py``) run over the lowered/compiled form of
+  the engine's jitted programs — donation-aliasing verification, dtype-
+  promotion audit, host-transfer detection, static collective schedule —
+  rebuilt from the abstract signatures compile telemetry records at each
+  cold dispatch. ``run_program_passes`` aggregates them into the report
+  both engines expose as ``analysis_report()``; the ``analysis.verify``
+  config knob runs them at first compile (warn or raise).
+* **Source lint** (``source_lint.py``, CLI: ``tools/lint.py``) is an AST
+  pass over the repo encoding python-level hazards (repeat-on-cache, host
+  syncs inside jit, shape branches, undonated buffers).
+"""
+
+from .passes import (  # noqa: F401
+    PROGRAM_PASSES,
+    AnalysisError,
+    PassResult,
+    ProgramArtifact,
+    Violation,
+    analyze_program,
+    collectives_pass,
+    donation_pass,
+    dtype_promotion_pass,
+    find_aval_shapes,
+    host_transfer_pass,
+    iter_eqns,
+)
+from .report import (  # noqa: F401
+    diff_trace_signatures,
+    engine_analysis_report,
+    format_violations,
+    raise_or_warn,
+    run_program_passes,
+    verify_program,
+)
+from .source_lint import LintFinding, lint_paths, lint_source  # noqa: F401

@@ -1,0 +1,173 @@
+"""Post-optimization HLO text parsing.
+
+The analysis passes that need *compile-time truth* — which donated buffers
+XLA actually aliased, which collectives GSPMD actually inserted, whether a
+host round-trip survived into the executable — read it from
+``compiled.as_text()``. Lowered StableHLO is not enough: SPMD partitioning
+inserts the collectives and the alias table is only fixed at compile time.
+
+Everything here is plain-text parsing of the stable parts of HLO syntax
+(``HloModule`` header attributes, ``%name = shape op-name(...)`` op lines);
+each helper degrades to "no results" rather than raising when the dialect
+drifts, so analysis stays best-effort on new XLA releases.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Set
+
+# HLO primitive-type byte widths (packed 4-bit types round up per element)
+_DTYPE_BYTES = {
+    "pred": 1,
+    "s2": 1, "u2": 1, "s4": 1, "u4": 1,
+    "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+# f8e4m3fn / f8e5m2 / f8e4m3b11fnuz ... — all one byte
+_F8_RE = re.compile(r"^f8e\w+$")
+
+_SHAPE_RE = re.compile(r"\b(pred|[suf]\d+|bf16|c64|c128|f8e\w+)\[([\d,]*)\]")
+
+# collective op names as they appear in optimized HLO; async pairs are
+# counted once on the -start half
+COLLECTIVE_OPS = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "all-to-all",
+    "collective-permute",
+    "collective-broadcast",
+)
+_COLLECTIVE_RE = re.compile(
+    r"=\s*((?:\([^)]*\))|(?:[\w\[\]{},]+))\s+("
+    + "|".join(re.escape(op) for op in COLLECTIVE_OPS)
+    + r")(-start|-done)?\("
+)
+
+# host-boundary ops: infeed/outfeed/send/recv plus python-callback
+# custom-calls (pure_callback / io_callback / debug lowerings)
+_HOST_OP_RE = re.compile(r"=\s*(?:\([^)]*\)|[\w\[\]{},]+)\s+(infeed|outfeed|send|recv)\(")
+_CALLBACK_TARGET_RE = re.compile(
+    r'custom_call_target="([^"]*(?:callback|python|host)[^"]*)"', re.IGNORECASE
+)
+_METADATA_OP_RE = re.compile(r'op_name="([^"]*)"')
+
+
+def dtype_bytes(dtype: str) -> int:
+    if dtype in _DTYPE_BYTES:
+        return _DTYPE_BYTES[dtype]
+    if _F8_RE.match(dtype):
+        return 1
+    return 4  # unknown type: assume word-sized rather than dropping the op
+
+
+def shape_list_bytes(shape_str: str) -> int:
+    """Total bytes of every ``dtype[dims]`` shape inside ``shape_str``
+    (handles tuple shapes: ``(f32[2,4]{1,0}, f32[])``). Shapes in optimized
+    SPMD HLO are per-partition, so the result is bytes *per participating
+    device*."""
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(shape_str):
+        n = 1
+        if dims:
+            for d in dims.split(","):
+                n *= int(d)
+        total += n * dtype_bytes(dtype)
+    return total
+
+
+def module_header(hlo_text: str) -> str:
+    for line in hlo_text.splitlines():
+        if line.startswith("HloModule"):
+            return line
+    return ""
+
+
+def parse_input_output_aliases(hlo_text: str) -> Set[int]:
+    """Parameter indices the compiled module aliases to an output — the
+    donations XLA honored. Parsed from the header's
+    ``input_output_alias={ {out}: (param, {path}, kind), ... }`` table."""
+    header = module_header(hlo_text)
+    m = re.search(r"input_output_alias=\{(.*?)\},\s*\w+=", header)
+    if m is None:
+        # table may be last attribute on the line
+        m = re.search(r"input_output_alias=\{(.*)\}", header)
+    if m is None:
+        return set()
+    return {int(p) for p in re.findall(r":\s*\(\s*(\d+)", m.group(1))}
+
+
+def entry_parameter_count(hlo_text: str) -> Optional[int]:
+    """Number of entry-computation parameters, or None if unparseable.
+    Used to detect argument pruning (``len(flat args_info)`` mismatch)."""
+    lines = hlo_text.splitlines()
+    start = None
+    for i, line in enumerate(lines):
+        if line.startswith("ENTRY "):
+            start = i
+            break
+    if start is None:
+        return None
+    idxs = []
+    for line in lines[start:]:
+        idxs.extend(int(i) for i in re.findall(r"=\s*[\w\[\]{},()]+\s+parameter\((\d+)\)", line))
+        if line.strip() == "}":
+            break
+    return (max(idxs) + 1) if idxs else 0
+
+
+def collect_collectives(hlo_text: str) -> Dict[str, Dict[str, Any]]:
+    """Static collective schedule: per op kind, occurrence count and total
+    payload bytes (per participating device, summed over occurrences).
+    Async ``-start``/``-done`` pairs count once, on the start half —
+    counting only the RESULT half of the start's ``(operands..., results...)``
+    bundle shape, so sync and async lowerings of the same program report
+    identical byte totals (async starts would otherwise double-count every
+    operand)."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for m in _COLLECTIVE_RE.finditer(hlo_text):
+        shape_str, op, suffix = m.group(1), m.group(2), m.group(3)
+        if suffix == "-done":
+            continue
+        rec = out.setdefault(op, {"count": 0, "bytes": 0})
+        rec["count"] += 1
+        if suffix == "-start":
+            shapes = _SHAPE_RE.findall(shape_str)
+            if len(shapes) >= 2 and len(shapes) % 2 == 0:
+                shapes = shapes[len(shapes) // 2 :]  # results only
+            nbytes = 0
+            for dtype, dims in shapes:
+                n = 1
+                if dims:
+                    for d in dims.split(","):
+                        n *= int(d)
+                nbytes += n * dtype_bytes(dtype)
+            rec["bytes"] += nbytes
+        else:
+            rec["bytes"] += shape_list_bytes(shape_str)
+    return out
+
+
+def find_host_ops(hlo_text: str) -> List[Dict[str, str]]:
+    """Host-boundary ops that survived into the executable: infeed/outfeed/
+    send/recv and python-callback custom-calls, each with the jax op_name
+    from its metadata when present."""
+    found: List[Dict[str, str]] = []
+    for line in hlo_text.splitlines():
+        m = _HOST_OP_RE.search(line)
+        kind = None
+        if m:
+            kind = m.group(1)
+        else:
+            cb = _CALLBACK_TARGET_RE.search(line)
+            if cb and "custom-call" in line:
+                kind = f"custom-call:{cb.group(1)}"
+        if kind is None:
+            continue
+        meta = _METADATA_OP_RE.search(line)
+        found.append({"op": kind, "jax_op": meta.group(1) if meta else ""})
+    return found

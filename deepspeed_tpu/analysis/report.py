@@ -1,0 +1,215 @@
+"""Aggregate analysis over a compile-telemetry registry.
+
+``run_program_passes`` is the single entry point both engines expose as
+``analysis_report()``: for every instrumented program that has dispatched
+at least once (so its abstract signature is on record), run the selected
+program passes and fold the results — plus retrace-cause diffs from the
+telemetry trace log — into one report dict that sits next to
+``compile_stats()`` in monitors, benches, and tests.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+from .passes import AnalysisError, analyze_program
+
+
+def diff_trace_signatures(
+    before: Dict[str, Any], after: Dict[str, Any]
+) -> List[Dict[str, Any]]:
+    """Name the arguments whose abstract signature changed between two
+    traces of the same program — the answer to "why did this retrace?".
+    Inputs are ``describe_signature`` dicts from a ProgramStats trace log."""
+    diffs: List[Dict[str, Any]] = []
+    for key in sorted(set(before) | set(after)):
+        a, b = before.get(key), after.get(key)
+        if a == b:
+            continue
+        if a is None:
+            reason = "added"
+        elif b is None:
+            reason = "removed"
+        elif a.get("shape") != b.get("shape"):
+            reason = "shape"
+        elif a.get("dtype") != b.get("dtype"):
+            reason = "dtype"
+        elif a.get("sharding") != b.get("sharding"):
+            reason = "sharding"
+        elif "value" in a or "value" in b:
+            reason = "static_value"
+        else:
+            reason = "changed"
+        diffs.append({"arg": key, "reason": reason, "before": a, "after": b})
+    return diffs
+
+
+def _retrace_causes(stats) -> List[Dict[str, Any]]:
+    log = getattr(stats, "trace_log", None) or []
+    causes = []
+    for i in range(1, len(log)):
+        causes.append(
+            {
+                "trace": i,
+                "changed": diff_trace_signatures(log[i - 1], log[i]),
+            }
+        )
+    return causes
+
+
+def run_program_passes(
+    telemetry,
+    programs: Optional[Sequence[str]] = None,
+    passes: Optional[Sequence[str]] = None,
+    config: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Run program passes over every (or the named) dispatched program in a
+    ``CompileTelemetry`` registry. Never raises on a broken program build —
+    the failure lands under that program's ``"error"`` key so one
+    unanalyzable program cannot hide the rest."""
+    available = telemetry.programs()
+    if programs is None:
+        selected = {
+            name: fn
+            for name, fn in available.items()
+            if fn.abstract_signature is not None
+        }
+    else:
+        # explicitly-requested names must never vanish silently: an unknown
+        # name lands as a failed entry (None wrapper) so the caller cannot
+        # read "verified" off a typo'd or not-yet-built program
+        selected = {name: available.get(name) for name in programs}
+
+    report: Dict[str, Any] = {"programs": {}, "totals": {}}
+    n_err = n_warn = n_failed = 0
+    donation_ok = True
+    donation_ran = False  # verified means the pass RAN clean, not "not run"
+    # a report that never had donation in scope stays None throughout —
+    # even its failure entries must not flip a flag nobody asked about
+    donation_selected = passes is None or "donation" in passes
+    coll_ops: Dict[str, Dict[str, int]] = {}
+    coll_bytes = coll_count = 0
+
+    for name in sorted(selected):
+        fn = selected[name]
+        entry: Dict[str, Any] = {"passes": {}}
+        stats = telemetry.program_stats(name)
+        if stats is not None:
+            entry["retraces"] = _retrace_causes(stats)
+        if fn is None or fn.abstract_signature is None:
+            entry["error"] = (
+                "no such instrumented program"
+                if fn is None
+                else "never dispatched: no captured signature"
+            )
+            n_failed += 1
+            if donation_selected:
+                donation_ok = False  # requested but unanalyzable ≠ verified
+                donation_ran = True
+            report["programs"][name] = entry
+            continue
+        try:
+            results = analyze_program(name, fn, passes=passes, config=config)
+        except Exception as e:  # artifact build failed (trace/compile error)
+            entry["error"] = f"{type(e).__name__}: {e}"
+            n_failed += 1
+            if donation_selected:
+                donation_ok = False  # unanalyzable ≠ verified
+                donation_ran = True
+            report["programs"][name] = entry
+            continue
+        for pname, res in results.items():
+            entry["passes"][pname] = res.as_dict()
+            for v in res.violations:
+                if v.severity == "error":
+                    n_err += 1
+                else:
+                    n_warn += 1
+            if pname == "donation":
+                donation_ran = True
+                if not res.ok:
+                    donation_ok = False
+            if pname == "collectives":
+                for op, rec in res.summary.get("ops", {}).items():
+                    agg = coll_ops.setdefault(op, {"count": 0, "bytes": 0})
+                    agg["count"] += rec["count"]
+                    agg["bytes"] += rec["bytes"]
+                coll_bytes += res.summary.get("total_bytes", 0)
+                coll_count += res.summary.get("total_count", 0)
+        report["programs"][name] = entry
+
+    report["totals"] = {
+        "programs": len(report["programs"]),
+        "violations": n_err,
+        "warnings": n_warn,
+        "analysis_failures": n_failed,
+        # None (not True) when the donation pass never ran: a report built
+        # from passes=["collectives"] must not read as donation-verified
+        "donation_verified": donation_ok if donation_ran else None,
+        "collective_count": coll_count,
+        "collective_bytes": coll_bytes,
+        "collectives": coll_ops,
+    }
+    return report
+
+
+def engine_analysis_report(
+    telemetry,
+    analysis_config,
+    programs: Optional[Sequence[str]] = None,
+    passes: Optional[Sequence[str]] = None,
+) -> Dict[str, Any]:
+    """The one implementation behind BOTH engines' ``analysis_report()``:
+    apply the config's pass narrowing + thresholds to
+    ``run_program_passes``. ``analysis_config`` is an ``AnalysisConfig``
+    (training or inference — same model)."""
+    if passes is None and analysis_config.passes:
+        passes = list(analysis_config.passes)
+    return run_program_passes(
+        telemetry,
+        programs=programs,
+        passes=passes,
+        config={
+            "min_donation_bytes": analysis_config.min_donation_bytes,
+            "collective_budget_bytes": analysis_config.collective_budget_bytes,
+        },
+    )
+
+
+def verify_program(telemetry, analysis_config, name: str, logger=None) -> None:
+    """analysis.verify hook body shared by both engines: run the passes on
+    one freshly compiled program, then warn or raise per the config."""
+    report = engine_analysis_report(telemetry, analysis_config, programs=[name])
+    raise_or_warn(report, analysis_config.verify, logger=logger)
+
+
+def format_violations(report: Dict[str, Any]) -> str:
+    """Human-readable one-line-per-violation rendering of a report."""
+    lines = []
+    for name, entry in report.get("programs", {}).items():
+        if entry.get("error"):
+            lines.append(f"{name}: analysis failed: {entry['error']}")
+        for pname, pres in entry.get("passes", {}).items():
+            for v in pres.get("violations", []):
+                lines.append(
+                    f"{name}: [{pname}/{v.get('severity', 'error')}] {v.get('message')}"
+                )
+    return "\n".join(lines)
+
+
+def raise_or_warn(report: Dict[str, Any], mode: str, logger=None) -> None:
+    """``analysis.verify`` enforcement: ``raise`` on any error-severity
+    violation OR analysis failure (a program the passes could not even
+    build — a typo'd pass name, an XLA drift breaking the re-trace — must
+    not silently disable the fail-fast gate), else log a warning when
+    anything was found."""
+    msg = format_violations(report)
+    if not msg:
+        return
+    totals = report["totals"]
+    if mode == "raise" and (
+        totals.get("violations", 0) > 0 or totals.get("analysis_failures", 0) > 0
+    ):
+        raise AnalysisError("static analysis failed:\n" + msg)
+    if logger is not None:
+        logger.warning("static analysis findings:\n%s", msg)

@@ -1,0 +1,354 @@
+"""Repo AST lint: the python-level hazards this codebase has been bitten by.
+
+The program passes see what XLA compiled; this lint sees what python will do
+*before* tracing ever happens — the class of bug that never reaches an HLO.
+Rules (each one traces back to a real incident in PERF.md / PR history):
+
+* **DS-R001 repeat-on-cache** — ``jnp.repeat`` applied to a cache-like
+  array (k/v/cache/page/pool names): materializes a G-times copy of the
+  widest buffer in the program (the PR-2 GQA decode blowup).
+* **DS-R002 host-sync-in-jit** — ``.item()`` / ``float()`` / ``int()`` /
+  ``bool()`` / ``np.asarray`` / ``jax.device_get`` applied to traced values
+  inside a jitted function: a ConcretizationTypeError at best, a silent
+  per-step host round-trip at worst.
+* **DS-R003 shape-branch-in-jit** (warn) — python ``if`` on ``.shape`` /
+  ``len()`` inside a jitted function: every new shape recompiles the
+  program (fine when deliberate — annotate with a pragma).
+* **DS-R004 jit-missing-donation** (warn) — a ``jax.jit`` / ``instrument``
+  call without ``donate_argnums`` whose wrapped function takes a
+  buffer-named parameter (grad_acc/opt_state/master/cache/pages/...):
+  likely double-buffering a state-sized array.
+
+Suppression: append ``# lint: allow(DS-RXXX)`` (or ``# noqa: DS-RXXX``) to
+the offending line. Findings in ``tests/`` are always downgraded to
+warnings by the CLI — the gate is for the library.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Set
+
+RULES = {
+    "DS-R001": "jnp.repeat on a cache-like array (G-times buffer copy)",
+    "DS-R002": "host sync on a traced value inside a jitted function",
+    "DS-R003": "shape-dependent python branch inside a jitted function",
+    "DS-R004": "jitted function with buffer-named args and no donate_argnums",
+}
+_WARN_ONLY = {"DS-R003", "DS-R004"}
+
+_CACHEY = re.compile(
+    r"(cache|page|pool|buffer|^kv$|^k$|^v$|^k_|^v_|_kv$|kv_)", re.IGNORECASE
+)
+_BUFFER_PARAMS = {
+    "grad_acc",
+    "opt_state",
+    "master",
+    "cache",
+    "pages",
+    "k_pages",
+    "v_pages",
+    "kv_pages",
+    "scale_state",
+}
+_SHAPEISH = {"shape", "ndim", "size", "dtype"}
+_PRAGMA = re.compile(r"(#\s*lint:\s*allow\(([^)]*)\)|#\s*noqa:\s*([\w,\s-]+))")
+
+
+@dataclass
+class LintFinding:
+    path: str
+    line: int
+    rule: str
+    message: str
+    severity: str = "error"  # resolved by the caller per path
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}/{self.severity}] {self.message}"
+
+
+def _dotted(node: ast.AST) -> str:
+    """'jnp.repeat' for Attribute chains, 'float' for Names, '' otherwise."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _identifiers(node: ast.AST) -> Set[str]:
+    names = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            names.add(n.id)
+        elif isinstance(n, ast.Attribute):
+            names.add(n.attr)
+    return names
+
+
+def _is_shapeish(node: ast.AST) -> bool:
+    """True when the expression only reads static structure (shapes, dims,
+    literals) — a trace-time constant, not a traced value."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and n.attr in _SHAPEISH:
+            return True
+        if isinstance(n, ast.Call) and _dotted(n.func) == "len":
+            return True
+    return False
+
+
+class _JitCollector(ast.NodeVisitor):
+    """First walk: which function names / lambda nodes get jitted here."""
+
+    JIT_FUNCS = {"jit", "jax.jit", "pjit", "_jit"}
+
+    def __init__(self):
+        self.jitted_names: Set[str] = set()
+        self.jitted_lambdas: List[ast.Lambda] = []
+        self.jit_calls: List[ast.Call] = []  # for DS-R004
+
+    def _is_jit_call(self, call: ast.Call) -> bool:
+        name = _dotted(call.func)
+        return (
+            name in self.JIT_FUNCS
+            or name.endswith(".jit")
+            or name.endswith(".instrument")
+            or name == "instrument"
+        )
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._is_jit_call(node):
+            self.jit_calls.append(node)
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    self.jitted_names.add(arg.id)
+                elif isinstance(arg, ast.Lambda):
+                    self.jitted_lambdas.append(arg)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        for dec in node.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            name = _dotted(target)
+            if name in self.JIT_FUNCS or name.endswith(".jit"):
+                self.jitted_names.add(node.name)
+            if isinstance(dec, ast.Call) and name.endswith("partial"):
+                for a in dec.args:
+                    if _dotted(a).endswith("jit"):
+                        self.jitted_names.add(node.name)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _fn_params(fn) -> Set[str]:
+    args = fn.args
+    names = [a.arg for a in args.args + args.posonlyargs + args.kwonlyargs]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    return set(names)
+
+
+def lint_source(src: str, path: str = "<string>") -> List[LintFinding]:
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [LintFinding(path, e.lineno or 0, "DS-R000", f"syntax error: {e.msg}")]
+    lines = src.splitlines()
+    findings: List[LintFinding] = []
+
+    def allowed(lineno: int, rule: str) -> bool:
+        if 1 <= lineno <= len(lines):
+            m = _PRAGMA.search(lines[lineno - 1])
+            if m:
+                codes = (m.group(2) or m.group(3) or "")
+                return rule in codes or codes.strip() == "*"
+        return False
+
+    def add(lineno: int, rule: str, message: str) -> None:
+        if not allowed(lineno, rule):
+            findings.append(LintFinding(path, lineno, rule, message))
+
+    collector = _JitCollector()
+    collector.visit(tree)
+
+    # resolve jitted names to FunctionDef nodes (module-wide, nearest wins
+    # is irrelevant — scrutinize every def carrying a jitted name)
+    fn_defs: Dict[str, List[ast.FunctionDef]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn_defs.setdefault(node.name, []).append(node)
+
+    jit_bodies: List[ast.AST] = list(collector.jitted_lambdas)
+    for name in collector.jitted_names:
+        jit_bodies.extend(fn_defs.get(name, []))
+
+    # ---- DS-R001: anywhere in the file --------------------------------
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = _dotted(node.func)
+        if not (fname.endswith(".repeat") and not fname.startswith("re.")):
+            continue
+        # the repeated array is args[0] in the function form
+        # (jnp.repeat(k_cache, G)) and the RECEIVER in the method form
+        # (k_cache.repeat(G)) — scan both
+        idents = set()
+        if node.args:
+            idents |= _identifiers(node.args[0])
+        if isinstance(node.func, ast.Attribute):
+            idents |= _identifiers(node.func.value)
+        if any(_CACHEY.search(i) for i in idents):
+            add(
+                node.lineno,
+                "DS-R001",
+                f"repeat on cache-like array ({', '.join(sorted(idents)[:3])}): "
+                "use grouped einsum instead of expanding kv heads",
+            )
+
+    # ---- DS-R002/R003 inside jitted bodies ----------------------------
+    seen_nodes: Set[int] = set()
+    for body in jit_bodies:
+        if id(body) in seen_nodes:
+            continue
+        seen_nodes.add(id(body))
+        params = _fn_params(body)
+        # closures: parameters of nested defs also count as traced values
+        for n in ast.walk(body):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                params |= _fn_params(n)
+        for n in ast.walk(body):
+            if isinstance(n, ast.Call):
+                fname = _dotted(n.func)
+                if (
+                    (fname == "item" or fname.endswith(".item"))
+                    and isinstance(n.func, ast.Attribute)
+                    and not n.args
+                ):
+                    add(n.lineno, "DS-R002", ".item() on a traced value inside jit")
+                elif fname in ("jax.device_get", "device_get"):
+                    add(n.lineno, "DS-R002", "jax.device_get inside a jitted function")
+                elif fname in ("np.asarray", "np.array", "numpy.asarray", "numpy.array", "onp.asarray"):
+                    if n.args and isinstance(n.args[0], ast.Name) and n.args[0].id in params:
+                        add(
+                            n.lineno,
+                            "DS-R002",
+                            f"{fname} on traced argument {n.args[0].id!r} inside jit",
+                        )
+                elif fname in ("float", "int", "bool") and n.args:
+                    arg = n.args[0]
+                    if (
+                        not _is_shapeish(arg)
+                        and not isinstance(arg, ast.Constant)
+                        and (_identifiers(arg) & params)
+                    ):
+                        add(
+                            n.lineno,
+                            "DS-R002",
+                            f"{fname}() on a traced value inside jit "
+                            "(concretizes or silently syncs)",
+                        )
+            elif isinstance(n, ast.If):
+                if _is_shapeish(n.test) and (_identifiers(n.test) & params):
+                    add(
+                        n.lineno,
+                        "DS-R003",
+                        "shape-dependent python branch inside a jitted function "
+                        "(each new shape recompiles)",
+                    )
+
+    # ---- DS-R004: jit call sites without donation ---------------------
+    for call in collector.jit_calls:
+        kwnames = {kw.arg for kw in call.keywords if kw.arg}
+        if "donate_argnums" in kwnames or "donate_argnames" in kwnames:
+            continue
+        for arg in call.args:
+            fn = None
+            if isinstance(arg, ast.Name):
+                defs = fn_defs.get(arg.id)
+                fn = defs[-1] if defs else None
+            elif isinstance(arg, ast.Lambda):
+                fn = arg
+            if fn is None:
+                continue
+            hit = _fn_params(fn) & _BUFFER_PARAMS
+            if hit:
+                add(
+                    call.lineno,
+                    "DS-R004",
+                    f"jitted function takes buffer args ({', '.join(sorted(hit))}) "
+                    "but the jit call declares no donate_argnums",
+                )
+                break
+    return findings
+
+
+def lint_paths(paths: Sequence[str]) -> List[LintFinding]:
+    findings: List[LintFinding] = []
+    for root in paths:
+        if os.path.isfile(root):
+            files = [root]
+        else:
+            files = []
+            for dirpath, dirnames, filenames in os.walk(root):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                files.extend(
+                    os.path.join(dirpath, f) for f in filenames if f.endswith(".py")
+                )
+        for f in sorted(files):
+            try:
+                with open(f, "r", encoding="utf-8") as fh:
+                    src = fh.read()
+            except (OSError, UnicodeDecodeError):
+                continue
+            findings.extend(lint_source(src, f))
+    return findings
+
+
+def resolve_severity(finding: LintFinding, warn_prefixes: Sequence[str] = ("tests",)) -> str:
+    """tests/ (and any other warn prefix) never fails the gate; warn-only
+    rules never fail anywhere."""
+    if finding.rule in _WARN_ONLY:
+        return "warn"
+    norm = finding.path.replace(os.sep, "/")
+    for p in warn_prefixes:
+        if norm.startswith(p.rstrip("/") + "/") or f"/{p.rstrip('/')}/" in norm:
+            return "warn"
+    return "error"
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+    import json as _json
+
+    ap = argparse.ArgumentParser(description="deepspeed_tpu repo AST lint")
+    ap.add_argument("paths", nargs="*", default=["deepspeed_tpu", "tests"])
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument(
+        "--warn-prefix",
+        action="append",
+        default=None,
+        help="path prefixes whose findings are warn-only (default: tests)",
+    )
+    ns = ap.parse_args(argv)
+    warn_prefixes = ns.warn_prefix if ns.warn_prefix else ["tests"]
+    findings = lint_paths(ns.paths)
+    n_err = 0
+    for f in findings:
+        f.severity = resolve_severity(f, warn_prefixes)
+        if f.severity == "error":
+            n_err += 1
+    if ns.format == "json":
+        print(_json.dumps([f.__dict__ for f in findings], indent=1))
+    else:
+        for f in findings:
+            print(f.render())
+        print(f"lint: {len(findings)} finding(s), {n_err} error(s)")
+    return 1 if n_err else 0

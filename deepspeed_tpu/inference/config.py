@@ -7,6 +7,7 @@ from typing import Any, Dict, Optional
 
 from pydantic import Field, model_validator
 
+from deepspeed_tpu.runtime.config import AnalysisConfig
 from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
 
 
@@ -97,6 +98,7 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     moe: DeepSpeedMoEConfig = Field(default_factory=DeepSpeedMoEConfig)
     quant: QuantizationConfig = Field(default_factory=QuantizationConfig)
     paged_kv: PagedKVConfig = Field(default_factory=PagedKVConfig)
+    analysis: AnalysisConfig = Field(default_factory=AnalysisConfig)
     checkpoint: Optional[Any] = None
     base_dir: str = ""
     set_empty_params: bool = False

@@ -440,7 +440,9 @@ def beam_generate(
 
     # tile to B*K OUTSIDE the loop: the loop's donated cache/out buffers are
     # then exactly the arrays it carries, so XLA aliases them in place
-    cache = KVCache(k=jnp.repeat(cache.k, K, axis=1), v=jnp.repeat(cache.v, K, axis=1))
+    # one-time beam tiling, not a per-step expansion: after divergence each
+    # beam owns its cache rows (the loop updates them in place per beam)
+    cache = KVCache(k=jnp.repeat(cache.k, K, axis=1), v=jnp.repeat(cache.v, K, axis=1))  # lint: allow(DS-R001)
     out0 = jnp.full((B * K, max_len), pad_token_id, tokens.dtype)
     out0 = jax.lax.dynamic_update_slice(out0, jnp.repeat(tokens, K, axis=0), (0, 0))
     logits = jnp.repeat(logits, K, axis=0)

@@ -81,6 +81,9 @@ class InferenceEngine:
         # — same contract as the training engine's compile_stats()
         self._telemetry = CompileTelemetry()
         self._paged_server = None  # lazy; rebuilt when weights change
+        # analysis.verify: static passes on each program at first compile
+        if self._config.analysis.verify != "off":
+            self._telemetry.on_compile = self._verify_program_static
 
         injected = False
         if self._config.replace_with_kernel_inject and _is_hf_model(model):
@@ -500,6 +503,27 @@ class InferenceEngine:
         slot bucket and exactly one ``paged_decode_*`` dispatch per decode
         step."""
         return self._telemetry.stats()
+
+    def analysis_report(self, programs=None, passes=None):
+        """Static-analysis report over every dispatched inference program
+        (or the named subset) — same contract as the training engine's
+        ``analysis_report()``: donation-aliasing, dtype-promotion,
+        host-transfer, and collective-schedule pass results per program,
+        retrace-cause diffs, and aggregate totals (``donation_verified``,
+        static collective bytes). The serving invariants become checkable
+        properties: every ``paged_decode_*`` / ``paged_prefill_*`` program
+        must alias its donated page buffers and contain no host callback."""
+        from deepspeed_tpu.analysis import engine_analysis_report
+
+        return engine_analysis_report(
+            self._telemetry, self._config.analysis, programs=programs, passes=passes
+        )
+
+    def _verify_program_static(self, name: str) -> None:
+        from deepspeed_tpu.analysis import verify_program
+        from deepspeed_tpu.utils.logging import logger
+
+        verify_program(self._telemetry, self._config.analysis, name, logger=logger)
 
     def _build_paged_server(self):
         from deepspeed_tpu.inference.scheduler import PagedServer

@@ -269,15 +269,19 @@ class TransformerLM(DSModule):
             sp_out = self._sp_attention(q, k, v, positions, dropout_rng, train, scale)
             if sp_out is not None:
                 return sp_out
-        k, v = _expand_gqa(q, k, v)
         return self._local_full_attention(q, k, v, positions, scale, dropout_rng, train)
 
     def _local_full_attention(self, q, k, v, positions, scale, dropout_rng=None, train=False):
-        """Full-sequence attention on (possibly head-sharded) q/k/v with
-        equal head counts: the single implementation used by the local path
-        and as the Ulysses local op."""
+        """Full-sequence attention on (possibly head-sharded) q/k/v: the
+        single implementation used by the local path and as the Ulysses
+        local op. GQA (NKV < NH) is computed by grouping the queries against
+        the shared kv rows — an NH-wide ``jnp.repeat`` of k/v here would
+        materialize a G-times copy of the [B, S, NKV, D] activations every
+        layer (the same blowup the paged decode path banned in PR 2); only
+        the fused flash kernel, which requires equal head counts, still
+        expands."""
         cfg = self.config
-        NH = q.shape[2]
+        NH, NKV = q.shape[2], k.shape[2]
         if (
             cfg.flash_attention
             and _flash_attention_available()
@@ -287,7 +291,29 @@ class TransformerLM(DSModule):
         ):
             from deepspeed_tpu.ops.transformer.flash_attention import flash_attention
 
+            if NKV != NH:
+                k, v = _expand_gqa(q, k, v)  # kernel contract: equal head counts
             return flash_attention(q, k, v, causal=True, scale=scale)
+        if NKV != NH:
+            # grouped GQA: heads stay [NKV, G]-factored through both einsums
+            B, T, _, D = q.shape
+            G = NH // NKV
+            qg = q.reshape(B, T, NKV, G, D)
+            scores = jnp.einsum("btkgd,bskd->bkgts", qg, k).astype(jnp.float32) * scale
+            if cfg.position == "alibi":
+                slopes = jnp.asarray(_alibi_slopes(NH), dtype=jnp.float32).reshape(NKV, G)
+                dist = (positions[:, None, :] - positions[:, :, None]).astype(jnp.float32)
+                scores = scores - slopes[None, :, :, None, None] * jnp.abs(dist)[:, None, None]
+            if cfg.causal:
+                mask = positions[:, None, None, :, None] >= positions[:, None, None, None, :]
+                scores = jnp.where(mask, scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1)
+            if train and cfg.attn_dropout > 0 and dropout_rng is not None:
+                keep = jax.random.bernoulli(dropout_rng, 1 - cfg.attn_dropout, probs.shape)
+                probs = probs * keep / (1 - cfg.attn_dropout)
+            probs = probs.astype(v.dtype)
+            out = jnp.einsum("bkgts,bskd->btkgd", probs, v)
+            return out.reshape(B, T, NH, D)
         scores = jnp.einsum("btnd,bsnd->bnts", q, k).astype(jnp.float32) * scale
         if cfg.position == "alibi":
             slopes = jnp.asarray(_alibi_slopes(NH), dtype=jnp.float32)
@@ -352,15 +378,15 @@ class TransformerLM(DSModule):
         expand_late = NKV != q.shape[2] and NKV % sp == 0
 
         def local_attn(q_, k_, v_):
-            if expand_late:
-                k_, v_ = _expand_gqa(q_, k_, v_)
+            # grouped-GQA local op: the group ratio survives the head
+            # scatter (NH/sp vs NKV/sp), so no expansion is needed here
             return self._local_full_attention(q_, k_, v_, positions, scale)
 
         dist_attn = DistributedAttention(
             local_attn, topo.mesh, batch_axes=batch_axes, head_axes=head_axes
         )
         if not expand_late:
-            k, v = _expand_gqa(q, k, v)
+            k, v = _expand_gqa(q, k, v)  # a2a head-count constraint: sp ∤ NKV
         return dist_attn(q, k, v)
 
     def _mlp(self, p, h, rng, train):
@@ -725,11 +751,14 @@ class TransformerLM(DSModule):
 
 
 def _expand_gqa(q, k, v):
-    """Repeat kv heads up to q's head count (no-op for MHA)."""
+    """Repeat kv heads up to q's head count — ONLY for consumers whose
+    contract requires equal head counts (the fused flash kernel, the
+    Ulysses head scatter when sp does not divide NKV). Regular attention
+    math must use the grouped einsum path instead (DS-R001)."""
     NH, NKV = q.shape[2], k.shape[2]
     if NKV != NH:
-        k = jnp.repeat(k, NH // NKV, axis=2)
-        v = jnp.repeat(v, NH // NKV, axis=2)
+        k = jnp.repeat(k, NH // NKV, axis=2)  # lint: allow(DS-R001)
+        v = jnp.repeat(v, NH // NKV, axis=2)  # lint: allow(DS-R001)
     return k, v
 
 

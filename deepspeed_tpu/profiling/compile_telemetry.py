@@ -20,6 +20,14 @@ test sessions (PERF.md round 5). This module makes both visible:
 The wrapper forwards ``lower``/``eval_shape``/``clear_cache`` to the
 underlying jitted callable, so AOT inspection (donation sets, cost analysis)
 and explicit executable release keep working through it.
+
+The registry is also the capture point for the static-analysis layer
+(``deepspeed_tpu/analysis``): each cold dispatch records the abstract call
+signature (shape/dtype/sharding per argument leaf — metadata survives
+buffer donation), so the analysis passes can re-derive the exact lowered
+and compiled program later without holding any live buffers, and the
+retrace-cause differ can name the argument whose aval/sharding changed
+between two traces of the same program.
 """
 
 from __future__ import annotations
@@ -28,9 +36,52 @@ import itertools
 import os
 import time
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 import jax
+
+# per-program cap on retained trace signatures: enough for the retrace
+# differ (consecutive pairs) without unbounded growth in resize loops
+_TRACE_LOG_CAP = 8
+
+
+def _abstract_leaf(x):
+    """ShapeDtypeStruct stand-in for an array leaf; any non-array leaf
+    (python scalar, None-in-dict, string) passes through verbatim so a
+    re-trace sees exactly the original weak-typed value. Shardings are kept
+    only for COMMITTED arrays — an uncommitted array does not constrain
+    jit's placement, but a ShapeDtypeStruct carrying its current
+    (single-device) sharding would, and the re-trace would then reject the
+    mesh-sharded neighbors it originally composed with."""
+    if isinstance(x, jax.Array):
+        try:
+            if getattr(x, "_committed", True):
+                return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+        except Exception:
+            pass
+        return jax.ShapeDtypeStruct(x.shape, x.dtype)
+    if hasattr(x, "shape") and hasattr(x, "dtype"):  # np.ndarray / np scalar
+        return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+    return x
+
+
+def describe_signature(args, kwargs) -> Dict[str, Dict[str, Any]]:
+    """Flat {arg path: leaf description} for one call signature. Safe on
+    donated (deleted) arrays — only metadata is read."""
+    flat, _ = jax.tree_util.tree_flatten_with_path((args, kwargs or {}))
+    out = {}
+    for path, leaf in flat:
+        key = jax.tree_util.keystr(path)
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            sharding = getattr(leaf, "sharding", None)
+            out[key] = {
+                "shape": tuple(leaf.shape),
+                "dtype": str(leaf.dtype),
+                "sharding": None if sharding is None else str(sharding),
+            }
+        else:
+            out[key] = {"value": repr(leaf)[:80], "type": type(leaf).__name__}
+    return out
 
 
 @dataclass
@@ -44,6 +95,9 @@ class ProgramStats:
     compile_seconds: float = 0.0  # wall time of trace-triggering dispatches
     invalidations: int = 0  # explicit clear_cache() calls
     first_compile_at: Optional[float] = field(default=None, repr=False)
+    # one entry per cold dispatch: the flat signature description the
+    # retrace-cause differ consumes (analysis/report.py)
+    trace_log: List[Dict[str, Any]] = field(default_factory=list, repr=False)
 
     def snapshot(self) -> Dict[str, Any]:
         return {
@@ -53,6 +107,11 @@ class ProgramStats:
             "compile_seconds": round(self.compile_seconds, 4),
             "invalidations": self.invalidations,
         }
+
+    def log_trace(self, signature: Dict[str, Any]) -> None:
+        self.trace_log.append(signature)
+        if len(self.trace_log) > _TRACE_LOG_CAP:
+            del self.trace_log[0]
 
 
 class InstrumentedFunction:
@@ -65,8 +124,19 @@ class InstrumentedFunction:
     dispatching, so they bump ``traces`` but never ``compiles``.
     """
 
-    def __init__(self, fn: Callable, stats: ProgramStats, jit_kwargs: Dict[str, Any]):
+    def __init__(
+        self,
+        fn: Callable,
+        stats: ProgramStats,
+        jit_kwargs: Dict[str, Any],
+        on_compile: Optional[Callable[[str], None]] = None,
+    ):
         self._stats = stats
+        self._on_compile = on_compile
+        # latest cold-dispatch signature as abstract pytrees: enough to
+        # re-trace/lower/compile the program for analysis without pinning
+        # any device buffer (donated args are captured as metadata)
+        self._abstract_signature = None
 
         def traced(*args, **kwargs):
             stats.traces += 1
@@ -86,7 +156,39 @@ class InstrumentedFunction:
             st.compile_seconds += time.perf_counter() - t0
             if st.first_compile_at is None:
                 st.first_compile_at = time.time()
+            # cold dispatch: record the signature for the analysis layer.
+            # Donated inputs are already consumed, but shape/dtype/sharding
+            # metadata outlives the buffer, so the capture is free of
+            # device memory. Best-effort: telemetry must never fail a step.
+            try:
+                self._abstract_signature = jax.tree_util.tree_map(
+                    _abstract_leaf, (args, kwargs)
+                )
+                st.log_trace(describe_signature(args, kwargs))
+            except Exception:
+                pass
+            if self._on_compile is not None:
+                self._on_compile(st.name)
         return out
+
+    # --- analysis surface ----------------------------------------------
+    @property
+    def abstract_signature(self):
+        """(args, kwargs) pytrees of ShapeDtypeStructs (+ verbatim
+        non-array leaves) from the latest cold dispatch, or None if the
+        program has never dispatched."""
+        return self._abstract_signature
+
+    def trace_abstract(self):
+        """Re-trace the program from the captured cold-dispatch signature.
+        Raises if the program has never dispatched."""
+        if self._abstract_signature is None:
+            raise ValueError(
+                f"program {self._stats.name!r} has no captured signature "
+                "(never dispatched through this wrapper)"
+            )
+        args, kwargs = self._abstract_signature
+        return self._jitted.trace(*args, **kwargs)
 
     # --- AOT / lifecycle pass-throughs ---------------------------------
     def lower(self, *args, **kwargs):
@@ -120,6 +222,13 @@ class CompileTelemetry:
 
     def __init__(self):
         self._programs: Dict[str, ProgramStats] = {}
+        # latest wrapper per name: the analysis passes re-derive lowered/
+        # compiled artifacts through it (only the newest build matters —
+        # stale wrappers are dropped so their executables can be GC'd)
+        self._fns: Dict[str, InstrumentedFunction] = {}
+        # optional hook fired (with the program name) after each cold
+        # dispatch completes — the engines use it for analysis.verify
+        self.on_compile: Optional[Callable[[str], None]] = None
         # process-unique, never-recycled id: module-level program caches
         # (inference/decode.py) key compiled callables on it — ``id(self)``
         # could alias a dead registry at a recycled address
@@ -130,7 +239,23 @@ class CompileTelemetry:
         Re-instrumenting an existing name (engine rebuild) accumulates into
         the same record."""
         stats = self._programs.setdefault(name, ProgramStats(name))
-        return InstrumentedFunction(fn, stats, jit_kwargs)
+        wrapper = InstrumentedFunction(
+            fn, stats, jit_kwargs, on_compile=self._fire_on_compile
+        )
+        self._fns[name] = wrapper
+        return wrapper
+
+    def _fire_on_compile(self, name: str) -> None:
+        # late-bound: engines set self.on_compile after instrument() calls
+        if self.on_compile is not None:
+            self.on_compile(name)
+
+    def programs(self) -> Dict[str, InstrumentedFunction]:
+        """{name: latest InstrumentedFunction} — the analysis layer's view."""
+        return dict(self._fns)
+
+    def program_stats(self, name: str) -> Optional[ProgramStats]:
+        return self._programs.get(name)
 
     def stats(self) -> Dict[str, Dict[str, Any]]:
         """Per-program counter snapshot: {name: {traces, compiles,
@@ -150,6 +275,7 @@ class CompileTelemetry:
 
     def reset(self) -> None:
         self._programs.clear()
+        self._fns.clear()
 
 
 def configure_persistent_cache(cache_dir: str, min_compile_secs: float = 0.0) -> bool:

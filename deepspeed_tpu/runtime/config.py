@@ -13,7 +13,7 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, List, Optional, Union
 
-from pydantic import Field
+from pydantic import Field, field_validator
 
 from deepspeed_tpu.runtime import constants as C
 from deepspeed_tpu.runtime.config_utils import (
@@ -144,6 +144,36 @@ class CompileConfig(DeepSpeedConfigModel):
     fuse_grad_accum: bool = False
     cache_dir: Optional[str] = None
     cache_min_compile_secs: float = 0.0
+
+
+class AnalysisConfig(DeepSpeedConfigModel):
+    """Static program-analysis controls (``deepspeed_tpu/analysis``).
+
+    ``verify`` runs the program passes (donation-aliasing, dtype-promotion,
+    host-transfer, collective budget) against each engine program right
+    after its first compile: ``"warn"`` logs findings, ``"raise"`` fails
+    fast on error-severity violations, ``"off"`` (default) leaves analysis
+    on-demand via ``engine.analysis_report()``. ``passes`` narrows the pass
+    list (empty = all). ``min_donation_bytes`` demotes unhonored donations
+    smaller than the threshold to warnings (XLA legitimately skips aliasing
+    tiny buffers on some backends). ``collective_budget_bytes`` turns the
+    collective extractor into a gate: any single program whose static
+    per-device collective payload exceeds the budget is a violation.
+    Verification re-traces and re-compiles each program once — pair it with
+    ``compile.cache_dir`` to make the second compile a cache hit.
+    """
+
+    verify: str = "off"  # off | warn | raise
+    passes: List[str] = Field(default_factory=list)
+    min_donation_bytes: int = 0
+    collective_budget_bytes: Optional[int] = None
+
+    @field_validator("verify")
+    @classmethod
+    def _check_verify(cls, v):
+        if v not in ("off", "warn", "raise"):
+            raise ValueError(f"analysis.verify must be off|warn|raise, got {v!r}")
+        return v
 
 
 class CommsLoggerConfig(DeepSpeedConfigModel):
@@ -342,6 +372,7 @@ class DeepSpeedConfig:
         self.scheduler_config = SchedulerConfig(**get(C.SCHEDULER, {})) if get(C.SCHEDULER) else None
         self.mesh_config = MeshConfig(**get(C.MESH, {}))
         self.compile_config = CompileConfig(**get(C.COMPILE, {}))
+        self.analysis_config = AnalysisConfig(**get("analysis", {}))
         self.comms_config = CommsConfig(**{"comms_logger": get(C.COMMS_LOGGER, {})})
         self.activation_checkpointing_config = ActivationCheckpointingConfig(
             **get("activation_checkpointing", {})

@@ -365,6 +365,13 @@ class DeepSpeedEngine:
         ccfg = self._config.compile_config
         if ccfg.cache_dir:
             configure_persistent_cache(ccfg.cache_dir, ccfg.cache_min_compile_secs)
+        # analysis.verify: run the static program passes against each
+        # program right after its first compile (warn or raise) — the
+        # donation/dtype/host-transfer/comms guarantees are checked where
+        # they are created, not rediscovered in a bench regression
+        acfg = self._config.analysis_config
+        if acfg.verify != "off":
+            self._telemetry.on_compile = self._verify_program_static
 
         self.training_dataloader = self.deepspeed_io(training_data) if training_data is not None else None
 
@@ -1262,7 +1269,7 @@ class DeepSpeedEngine:
                 )
                 return jnp.sqrt(sq) * inv, overflow
 
-            self._jit_grad_stats = self._telemetry.instrument("grad_stats", grad_stats)
+            self._jit_grad_stats = self._telemetry.instrument("grad_stats", grad_stats)  # lint: allow(DS-R004) — read-only: the host Adam re-reads grad_acc after
             self._jit_zero_grads = self._telemetry.instrument(
                 "zero_grads",
                 lambda t: jax.tree_util.tree_map(jnp.zeros_like, t),
@@ -1786,6 +1793,28 @@ class DeepSpeedEngine:
         shows gas ``fwd_bwd`` dispatches + one ``step`` per optimizer step."""
         return self._telemetry.stats()
 
+    def analysis_report(self, programs=None, passes=None) -> Dict[str, Any]:
+        """Static-analysis report over every dispatched engine program (or
+        the named subset): per program, the donation-aliasing, dtype-
+        promotion, host-transfer, and collective-schedule pass results plus
+        retrace-cause diffs; ``totals`` aggregates violation counts, a
+        ``donation_verified`` flag, and the static per-device collective
+        bytes the bench records track. Sits next to ``compile_stats()`` —
+        same registry, compile-time truth instead of runtime counters.
+        Re-traces and re-compiles each analyzed program once (abstract
+        shapes only: no device buffers are touched)."""
+        from deepspeed_tpu.analysis import engine_analysis_report
+
+        return engine_analysis_report(
+            self._telemetry, self._config.analysis_config, programs=programs, passes=passes
+        )
+
+    def _verify_program_static(self, name: str) -> None:
+        """analysis.verify hook: passes over one freshly compiled program."""
+        from deepspeed_tpu.analysis import verify_program
+
+        verify_program(self._telemetry, self._config.analysis_config, name, logger=logger)
+
     def train_batch(self, data_iter=None, batch=None):
         """Convenience: run a full GAS cycle — gas × fwd/bwd + step, or,
         with ``compile.fuse_grad_accum`` on, ONE fused jitted program for
@@ -1822,8 +1851,10 @@ class DeepSpeedEngine:
             self.backward(loss)
             self.step()
             losses.append(loss)
-        total = sum(jax.device_get(l) for l in losses) / len(losses)
-        return total
+        # one batched fetch, not gas sequential round-trips (each
+        # device_get is a blocking host RTT on the tunneled backend)
+        vals = jax.device_get(losses)
+        return sum(vals) / len(vals)
 
     def _fused_train_batch(self, micro):
         """Single-dispatch optimizer step (``compile.fuse_grad_accum``): the

@@ -136,9 +136,9 @@ class Quantizer:
 
         def quantize_tree(params, ratio):
             def leaf(w):
-                if not hasattr(w, "ndim") or w.ndim < 2 or not jnp.issubdtype(w.dtype, jnp.floating):
+                if not hasattr(w, "ndim") or w.ndim < 2 or not jnp.issubdtype(w.dtype, jnp.floating):  # lint: allow(DS-R003) — per-leaf structural dispatch, trace-time constant
                     return w
-                if w.dtype == jnp.float32:
+                if w.dtype == jnp.float32:  # lint: allow(DS-R003) — keep_fp32_params contract, trace-time constant
                     # keep_fp32_params leaves stay full precision in the
                     # mixed-precision compute tree — honor that request
                     return w

@@ -1,0 +1,381 @@
+"""Red fixtures: every analysis pass must flag its deliberately-broken
+miniature program (ISSUE 3 acceptance — a pass that cannot fail cannot
+guard anything). Each fixture is the smallest program exhibiting one
+hazard: a donated-but-unaliasable buffer, an un-aliased scan carry, a
+silent bf16→f32 upcast feeding a matmul, a host callback inside the
+program, and a known collective schedule the extractor must count
+exactly. The retrace differ is driven with two signatures of the same
+program and must name the argument that changed.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.analysis import (
+    analyze_program,
+    diff_trace_signatures,
+    find_aval_shapes,
+    run_program_passes,
+)
+from deepspeed_tpu.profiling.compile_telemetry import CompileTelemetry
+
+
+def _dispatch(tel, name, fn, *args, **jit_kwargs):
+    wrapped = tel.instrument(name, fn, **jit_kwargs)
+    with warnings.catch_warnings():
+        # the broken-donation fixtures intentionally trip jax's
+        # "donated argument was not used" warning
+        warnings.simplefilter("ignore")
+        wrapped(*args)
+    return wrapped
+
+
+# ---------------------------------------------------------------------------
+# donation
+# ---------------------------------------------------------------------------
+def test_donation_red_unaliasable_buffer():
+    """A donated buffer no output can alias (shape matches nothing) must be
+    reported with its double-buffered bytes."""
+    tel = CompileTelemetry()
+
+    def f(big, x):
+        return x * 2.0
+
+    _dispatch(tel, "bad", f, jnp.ones((128, 128)), jnp.ones((4,)), donate_argnums=(0,))
+    res = analyze_program("bad", tel.programs()["bad"], passes=["donation"])["donation"]
+    assert not res.ok
+    assert res.violations, "unhonored donation not reported"
+
+
+def test_donation_red_unaliased_scan_carry():
+    """A scan whose carry is returned at a different dtype than the donated
+    input cannot alias it — the pass reports the double-buffer."""
+    tel = CompileTelemetry()
+
+    def f(carry, xs):
+        def body(c, x):
+            return c + x.astype(c.dtype), ()
+
+        out, _ = jax.lax.scan(body, carry, xs)
+        return out.astype(jnp.bfloat16)  # dtype change: no alias possible
+
+    _dispatch(
+        tel, "scan_carry", f,
+        jnp.zeros((64, 64), jnp.float32), jnp.ones((4, 64, 64), jnp.float32),
+        donate_argnums=(0,),
+    )
+    res = analyze_program(
+        "scan_carry", tel.programs()["scan_carry"], passes=["donation"]
+    )["donation"]
+    assert not res.ok
+    assert any(v.details.get("bytes", 0) >= 64 * 64 * 4 for v in res.violations) or \
+        any("double-buffered" in v.message for v in res.violations)
+
+
+def test_donation_green_aliased_state():
+    tel = CompileTelemetry()
+
+    def step(state):
+        return jax.tree_util.tree_map(lambda a: a + 1.0, state)
+
+    _dispatch(
+        tel, "ok", step, {"w": jnp.ones((32, 32)), "m": jnp.ones((32, 32))},
+        donate_argnums=(0,),
+    )
+    res = analyze_program("ok", tel.programs()["ok"], passes=["donation"])["donation"]
+    assert res.ok
+    assert res.summary["declared_donations"] == 2
+
+
+def test_donation_min_bytes_demotes_small_buffers():
+    tel = CompileTelemetry()
+
+    def f(tiny, x):
+        return x * 2.0
+
+    _dispatch(tel, "tiny", f, jnp.ones((2,)), jnp.ones((4,)), donate_argnums=(0,))
+    res = analyze_program(
+        "tiny", tel.programs()["tiny"], passes=["donation"],
+        config={"min_donation_bytes": 1024},
+    )["donation"]
+    # still reported, but below the byte threshold → warn, not error
+    assert res.violations
+    assert res.ok
+
+
+# ---------------------------------------------------------------------------
+# dtype promotion
+# ---------------------------------------------------------------------------
+def test_dtype_red_silent_f32_upcast_matmul():
+    tel = CompileTelemetry()
+
+    def f(w, x):
+        return w.astype(jnp.float32) @ x.astype(jnp.float32)
+
+    _dispatch(tel, "upcast", f, jnp.ones((8, 8), jnp.bfloat16), jnp.ones((8, 8), jnp.bfloat16))
+    res = analyze_program(
+        "upcast", tel.programs()["upcast"], passes=["dtype_promotion"]
+    )["dtype_promotion"]
+    assert not res.ok
+    assert any("dot_general" in v.message for v in res.violations)
+
+
+def test_dtype_red_upcast_inside_scan():
+    """Taint must follow into control-flow bodies (the fused-accum scan is
+    where a silent upcast would actually hide)."""
+    tel = CompileTelemetry()
+
+    def f(w, xs):
+        def body(c, x):
+            return c + (w.astype(jnp.float32) @ x.astype(jnp.float32)), ()
+
+        out, _ = jax.lax.scan(body, jnp.zeros((8, 8), jnp.float32), xs)
+        return out
+
+    _dispatch(tel, "scan_upcast", f, jnp.ones((8, 8), jnp.bfloat16), jnp.ones((2, 8, 8), jnp.bfloat16))
+    res = analyze_program(
+        "scan_upcast", tel.programs()["scan_upcast"], passes=["dtype_promotion"]
+    )["dtype_promotion"]
+    assert not res.ok
+
+
+def test_dtype_green_softmax_boundary():
+    """Softmax-in-f32 followed by a downcast PV matmul is the sanctioned
+    pattern — zero violations."""
+    tel = CompileTelemetry()
+
+    def attn(q, k, v):
+        s = (q @ k.T).astype(jnp.float32)
+        p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+        return p @ v
+
+    _dispatch(tel, "attn", attn, *[jnp.ones((8, 8), jnp.bfloat16)] * 3)
+    res = analyze_program(
+        "attn", tel.programs()["attn"], passes=["dtype_promotion"]
+    )["dtype_promotion"]
+    assert res.ok, [v.message for v in res.violations]
+
+
+def test_dtype_green_master_weight_update():
+    """The mixed-precision optimizer pattern (bf16 grads upcast to f32 for
+    elementwise update math against f32 master) is allowlisted by
+    construction: no matmul touches the upcast values."""
+    tel = CompileTelemetry()
+
+    def update(master, grad_bf16):
+        g32 = grad_bf16.astype(jnp.float32)
+        new_master = master - 0.1 * g32
+        return new_master, new_master.astype(jnp.bfloat16)
+
+    _dispatch(tel, "update", update, jnp.ones((16, 16), jnp.float32), jnp.ones((16, 16), jnp.bfloat16))
+    res = analyze_program(
+        "update", tel.programs()["update"], passes=["dtype_promotion"]
+    )["dtype_promotion"]
+    assert res.ok, [v.message for v in res.violations]
+
+
+# ---------------------------------------------------------------------------
+# host transfer
+# ---------------------------------------------------------------------------
+def test_host_transfer_red_pure_callback():
+    tel = CompileTelemetry()
+
+    def f(x):
+        y = jax.pure_callback(
+            lambda a: np.asarray(a) * 2.0, jax.ShapeDtypeStruct((4,), jnp.float32), x
+        )
+        return y + 1.0
+
+    _dispatch(tel, "cb", f, jnp.ones((4,)))
+    res = analyze_program(
+        "cb", tel.programs()["cb"], passes=["host_transfer"]
+    )["host_transfer"]
+    assert not res.ok
+    assert any("pure_callback" in v.message for v in res.violations)
+
+
+def test_host_transfer_green_pure_math():
+    tel = CompileTelemetry()
+    _dispatch(tel, "clean", lambda x: jnp.tanh(x) * 2.0, jnp.ones((16,)))
+    res = analyze_program(
+        "clean", tel.programs()["clean"], passes=["host_transfer"]
+    )["host_transfer"]
+    assert res.ok
+
+
+# ---------------------------------------------------------------------------
+# collectives
+# ---------------------------------------------------------------------------
+def test_collectives_extractor_counts_known_schedule(eight_devices):
+    """A program with exactly one dp all-reduce of a known payload: the
+    extractor must report op kind, count, and per-device bytes."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("dp",))
+    s = NamedSharding(mesh, P("dp"))
+    tel = CompileTelemetry()
+
+    def f(x):
+        return x - jnp.mean(x)  # mean over the sharded axis → one all-reduce
+
+    x = jax.device_put(jnp.arange(64.0).reshape(64, 1), NamedSharding(mesh, P("dp", None)))
+    _dispatch(tel, "ar", f, x)
+    res = analyze_program("ar", tel.programs()["ar"], passes=["collectives"])["collectives"]
+    ops = res.summary["ops"]
+    assert "all-reduce" in ops, res.summary
+    assert ops["all-reduce"]["count"] >= 1
+    assert res.summary["total_bytes"] >= 4  # ≥ one f32 scalar per device
+
+
+def test_collectives_budget_gate(eight_devices):
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("dp",))
+    tel = CompileTelemetry()
+
+    def f(x):
+        return x - jnp.mean(x)
+
+    x = jax.device_put(jnp.ones((64, 8)), NamedSharding(mesh, P("dp", None)))
+    _dispatch(tel, "budget", f, x)
+    res = analyze_program(
+        "budget", tel.programs()["budget"], passes=["collectives"],
+        config={"collective_budget_bytes": 0},
+    )["collectives"]
+    assert not res.ok
+    assert "budget" in res.violations[0].message
+
+
+# ---------------------------------------------------------------------------
+# retrace-cause differ
+# ---------------------------------------------------------------------------
+def test_retrace_differ_names_offending_argument():
+    tel = CompileTelemetry()
+    f = tel.instrument("prog", lambda a, b: a + b)
+    f(jnp.ones((4, 4)), jnp.ones((4, 4)))
+    f(jnp.ones((8, 4)), jnp.ones((8, 4)))  # retrace: arg shapes changed
+    f(jnp.ones((8, 4)), jnp.ones((8, 4), jnp.bfloat16))  # retrace: b's dtype
+    log = tel.program_stats("prog").trace_log
+    assert len(log) == 3
+    first = diff_trace_signatures(log[0], log[1])
+    assert first and all(d["reason"] == "shape" for d in first)
+    second = diff_trace_signatures(log[1], log[2])
+    assert len(second) == 1
+    assert second[0]["reason"] == "dtype"
+    assert "[1]" in second[0]["arg"]  # names argument b, not a
+
+    # the report surfaces the same diffs under the program entry
+    rep = run_program_passes(tel, programs=["prog"], passes=["host_transfer"])
+    retraces = rep["programs"]["prog"]["retraces"]
+    assert len(retraces) == 2
+    assert retraces[1]["changed"][0]["reason"] == "dtype"
+
+
+def test_report_aggregates_and_flags():
+    """run_program_passes folds per-program results into totals the bench
+    and the engines consume (donation_verified, collective bytes)."""
+    tel = CompileTelemetry()
+    _dispatch(tel, "good", lambda s: jax.tree_util.tree_map(lambda a: a * 2, s),
+              {"w": jnp.ones((16, 16))}, donate_argnums=(0,))
+
+    def bad(big, x):
+        return x + 1
+
+    _dispatch(tel, "bad", bad, jnp.ones((64, 64)), jnp.ones((4,)), donate_argnums=(0,))
+    rep = run_program_passes(tel)
+    assert rep["totals"]["programs"] == 2
+    assert rep["totals"]["donation_verified"] is False
+    assert rep["programs"]["good"]["passes"]["donation"]["ok"] is True
+    assert rep["programs"]["bad"]["passes"]["donation"]["ok"] is False
+    # never-dispatched programs are skipped by the default selection...
+    tel.instrument("never_ran", lambda x: x)
+    rep2 = run_program_passes(tel)
+    assert "never_ran" not in rep2["programs"]
+    # ...but an EXPLICIT request for an unanalyzable or unknown name must
+    # surface as a counted failure, never as a clean "verified" report
+    rep3 = run_program_passes(tel, programs=["never_ran", "no_such_prog"])
+    assert rep3["programs"]["never_ran"]["error"]
+    assert rep3["programs"]["no_such_prog"]["error"]
+    assert rep3["totals"]["analysis_failures"] == 2
+    assert rep3["totals"]["donation_verified"] is False
+    # and a report that never ran the donation pass must not claim it:
+    # None (indeterminate), not True — even when a requested program fails
+    rep4 = run_program_passes(tel, programs=["good"], passes=["collectives"])
+    assert rep4["totals"]["donation_verified"] is None
+    rep5 = run_program_passes(tel, programs=["no_such_prog"], passes=["collectives"])
+    assert rep5["totals"]["analysis_failures"] == 1
+    assert rep5["totals"]["donation_verified"] is None
+
+
+def test_raise_mode_trips_on_analysis_failure():
+    """A typo'd pass name (or any artifact build error) must not silently
+    disable verify=raise: analysis failures raise, not just violations."""
+    import pytest
+
+    from deepspeed_tpu.analysis import AnalysisError, raise_or_warn
+
+    tel = CompileTelemetry()
+    _dispatch(tel, "p", lambda x: x + 1, jnp.ones((4,)))
+    rep = run_program_passes(tel, programs=["p"], passes=["donations"])  # typo
+    assert rep["totals"]["analysis_failures"] == 1
+    with pytest.raises(AnalysisError):
+        raise_or_warn(rep, "raise")
+
+
+def test_donation_pruned_partial_shortfall_reported():
+    """With an unused (pruned) arg breaking the index mapping, a donated
+    buffer that went unhonored must still surface — as a warn-severity
+    'partially unverifiable' violation, never as a clean verified pass."""
+    tel = CompileTelemetry()
+
+    def f(big, unused, state):
+        return big.astype(jnp.bfloat16), state + 1.0  # big cannot alias
+
+    _dispatch(
+        tel, "partial", f,
+        jnp.ones((256, 256)), jnp.ones((8,)), jnp.ones((16,)),
+        donate_argnums=(0, 2),
+    )
+    res = analyze_program(
+        "partial", tel.programs()["partial"], passes=["donation"]
+    )["donation"]
+    assert "arg_pruning" in res.summary
+    assert res.violations, "partial unhonored donation invisible under pruning"
+
+
+def test_collective_bytes_async_start_equals_sync():
+    """Async ``-start`` bundles carry (operands..., results...) tuple
+    shapes; the extractor must count only the result half so sync and
+    async lowerings of one program report identical byte totals."""
+    from deepspeed_tpu.analysis.hlo import collect_collectives
+
+    sync = '%ag = f32[64,256]{1,0} all-gather(f32[8,256]{1,0} %p), dimensions={0}\n'
+    async_ = (
+        '%ags = (f32[8,256]{1,0}, f32[64,256]{1,0}) all-gather-start(f32[8,256]{1,0} %p), dimensions={0}\n'
+        '%agd = f32[64,256]{1,0} all-gather-done((f32[8,256]{1,0}, f32[64,256]{1,0}) %ags)\n'
+    )
+    s = collect_collectives(sync)["all-gather"]
+    a = collect_collectives(async_)["all-gather"]
+    assert s["count"] == a["count"] == 1
+    assert s["bytes"] == a["bytes"] == 64 * 256 * 4
+
+
+# ---------------------------------------------------------------------------
+# jaxpr shape scan (the paged-attention structural guard's engine)
+# ---------------------------------------------------------------------------
+def test_find_aval_shapes_sees_through_control_flow():
+    def f(x):
+        def body(c, _):
+            return c, jnp.broadcast_to(c, (3, 4, 4))  # materializes [3,4,4]
+
+        _, ys = jax.lax.scan(body, x, jnp.arange(2))
+        return ys
+
+    jaxpr = jax.make_jaxpr(f)(jnp.ones((4, 4)))
+    assert find_aval_shapes(jaxpr, (3, 4, 4))
+    assert not find_aval_shapes(jaxpr, (9, 9, 9))

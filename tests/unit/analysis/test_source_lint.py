@@ -1,0 +1,151 @@
+"""AST lint unit tests: each rule fires on its minimal bad snippet, stays
+quiet on the sanctioned idiom, honors pragmas — and the library itself
+lints clean (the CI gate ``tools/lint.sh`` enforces: error findings under
+``deepspeed_tpu/`` fail, ``tests/`` findings are warn-only)."""
+
+from __future__ import annotations
+
+import os
+import textwrap
+
+from deepspeed_tpu.analysis.source_lint import (
+    lint_paths,
+    lint_source,
+    resolve_severity,
+)
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+)
+
+
+def _rules(src):
+    return [f.rule for f in lint_source(textwrap.dedent(src))]
+
+
+def test_r001_repeat_on_cache_flagged():
+    assert "DS-R001" in _rules("""
+        import jax.numpy as jnp
+        def f(k_cache, G):
+            return jnp.repeat(k_cache, G, axis=2)
+    """)
+
+
+def test_r001_method_form_flagged():
+    """k_cache.repeat(G) is the same hazard as jnp.repeat(k_cache, G):
+    the rule must scan the method receiver, not just args[0]."""
+    assert "DS-R001" in _rules("""
+        def f(k_cache, G):
+            return k_cache.repeat(G, axis=2)
+    """)
+
+
+def test_r001_ignores_non_cache_repeat():
+    assert "DS-R001" not in _rules("""
+        import jax.numpy as jnp
+        def f(logits, G):
+            return jnp.repeat(logits, G, axis=0)
+    """)
+
+
+def test_r001_pragma_suppresses():
+    assert "DS-R001" not in _rules("""
+        import jax.numpy as jnp
+        def f(k_cache, G):
+            return jnp.repeat(k_cache, G, axis=2)  # lint: allow(DS-R001)
+    """)
+
+
+def test_r002_item_inside_jit():
+    assert "DS-R002" in _rules("""
+        import jax
+        def step(params, batch):
+            lr = params["lr"].item()
+            return params
+        step_fn = jax.jit(step)
+    """)
+
+
+def test_r002_float_on_traced_arg():
+    assert "DS-R002" in _rules("""
+        import jax
+        @jax.jit
+        def step(loss, x):
+            return x * float(loss)
+    """)
+
+
+def test_r002_float_on_shape_ok():
+    assert "DS-R002" not in _rules("""
+        import jax
+        @jax.jit
+        def step(x):
+            return x * float(x.shape[0])
+    """)
+
+
+def test_r002_nested_closure_inside_instrument():
+    """Functions jitted via telemetry.instrument get the same scrutiny,
+    including their nested closures."""
+    assert "DS-R002" in _rules("""
+        def build(telemetry):
+            def fused(params, batch):
+                def scaled(p):
+                    return float(batch) * 2
+                return scaled(params)
+            return telemetry.instrument("fused", fused)
+    """)
+
+
+def test_r002_not_flagged_outside_jit():
+    assert "DS-R002" not in _rules("""
+        def host_logging(loss):
+            return float(loss)
+    """)
+
+
+def test_r003_shape_branch_warns():
+    findings = lint_source(textwrap.dedent("""
+        import jax
+        @jax.jit
+        def step(x):
+            if x.shape[0] > 4:
+                return x * 2
+            return x
+    """))
+    assert any(f.rule == "DS-R003" for f in findings)
+    f = next(f for f in findings if f.rule == "DS-R003")
+    assert resolve_severity(f) == "warn"  # warn-only rule, any path
+
+
+def test_r004_missing_donation_on_buffer_args():
+    findings = lint_source(textwrap.dedent("""
+        import jax
+        def step(master, opt_state, grad_acc):
+            return master, opt_state, grad_acc
+        jitted = jax.jit(step)
+        donated = jax.jit(step, donate_argnums=(0, 1, 2))
+    """))
+    r004 = [f for f in findings if f.rule == "DS-R004"]
+    assert len(r004) == 1  # only the undonated call site
+
+
+def test_severity_tests_path_is_warn_only():
+    f = lint_source("import jax.numpy as jnp\nx = jnp.repeat(k_cache, 2)\n", path="tests/unit/foo.py")[0]
+    assert f.rule == "DS-R001"
+    assert resolve_severity(f) == "warn"
+    f2 = lint_source("import jax.numpy as jnp\nx = jnp.repeat(k_cache, 2)\n", path="deepspeed_tpu/foo.py")[0]
+    assert resolve_severity(f2) == "error"
+
+
+def test_library_lints_clean():
+    """The gate itself: zero error-severity findings in deepspeed_tpu/
+    (deliberate sites carry pragmas) — what tools/lint.sh enforces per
+    commit."""
+    findings = lint_paths([os.path.join(REPO, "deepspeed_tpu")])
+    errors = [
+        f.render()
+        for f in findings
+        if resolve_severity(f) == "error"
+    ]
+    assert not errors, "\n".join(errors)

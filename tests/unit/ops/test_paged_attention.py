@@ -183,13 +183,58 @@ def test_dense_fallback_gqa_has_no_repeat():
     ref = jnp.einsum("bnts,bsnd->btnd", jax.nn.softmax(scores, -1), vr)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
     # structural guard: no intermediate may materialize an NH-wide cache
-    # copy [B, S, NH, D] (what jnp.repeat(k_cache, G, axis=2) produced)
+    # copy [B, S, NH, D] (what jnp.repeat(k_cache, G, axis=2) produced) —
+    # checked by the analysis layer's recursive shape scan (sees through
+    # scan/pjit bodies, unlike the old top-level eqn loop)
+    from deepspeed_tpu.analysis import find_aval_shapes
+
     jaxpr = jax.make_jaxpr(
         lambda q, k, v: _cached_attention(cfg, q, k, v, q_pos, mask)
     )(q, k, v)
     banned = (B, S, 8, 8)
+    hits = find_aval_shapes(jaxpr, banned)
+    assert not hits, f"decode fallback materializes an NH-wide cache: {hits}"
+    # legacy cross-check (top-level eqns only): keeps the analysis helper
+    # honest against a hand-rolled scan of the same jaxpr
     for eqn in jaxpr.jaxpr.eqns:
         for var in eqn.outvars:
             assert tuple(getattr(var.aval, "shape", ())) != banned, (
                 f"decode fallback materializes an NH-wide cache: {eqn.primitive}"
             )
+
+
+def test_training_gqa_attention_has_no_repeat():
+    """Satellite guard for the training-side GQA fix: the grouped einsum
+    path in ``_local_full_attention`` must not materialize NH-wide k/v
+    copies (what ``_expand_gqa``'s jnp.repeat produced). With grouping,
+    the ONLY [B, T, NH, D] tensor in the attention body is the final
+    output reshape; an expansion-based path adds NH-wide k and v too."""
+    from deepspeed_tpu.analysis import find_aval_shapes
+    from deepspeed_tpu.models import TransformerLM
+    from deepspeed_tpu.models.config import TransformerConfig
+
+    cfg = TransformerConfig(
+        vocab_size=64, hidden_size=64, num_layers=1, num_heads=8,
+        num_kv_heads=2, max_seq_len=16, flash_attention=False, dtype="float32",
+    )
+    model = TransformerLM(cfg)
+    B, T, NH, NKV, D = 2, 16, 8, 2, 8
+    rs = np.random.RandomState(7)
+    q = jnp.asarray(rs.randn(B, T, NH, D).astype(np.float32))
+    k = jnp.asarray(rs.randn(B, T, NKV, D).astype(np.float32))
+    v = jnp.asarray(rs.randn(B, T, NKV, D).astype(np.float32))
+    pos = jnp.asarray(np.tile(np.arange(T, dtype=np.int32), (B, 1)))
+    jaxpr = jax.make_jaxpr(
+        lambda q, k, v: model._local_full_attention(q, k, v, pos, 1.0 / np.sqrt(D))
+    )(q, k, v)
+    nh_wide = find_aval_shapes(jaxpr, (B, T, NH, D))
+    assert len(nh_wide) <= 1, (
+        f"NH-wide tensors materialized in GQA attention (expansion?): {nh_wide}"
+    )
+    grouped = find_aval_shapes(jaxpr, (B, T, NKV, NH // NKV, D))
+    assert grouped, "grouped [B,T,NKV,G,D] factoring missing — GQA regressed"
+    # numerics: grouped math equals the repeat-expansion reference
+    out = model._local_full_attention(q, k, v, pos, 1.0 / np.sqrt(D))
+    kr, vr = jnp.repeat(k, NH // NKV, axis=2), jnp.repeat(v, NH // NKV, axis=2)
+    ref = model._local_full_attention(q, kr, vr, pos, 1.0 / np.sqrt(D))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)

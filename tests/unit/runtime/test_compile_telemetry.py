@@ -1,12 +1,13 @@
 """Compile telemetry + full-state donation.
 
-Donation is verified two ways: functionally (the step consumes its input
-buffers — they are deleted after the call) and structurally (the compiled
-step program carries input/output aliases and a nonzero aliased-bytes
-figure in ``memory_analysis()``). The retrace guard asserts ≤1 compile of
-the step programs across a 5-step loop via the new counters, and the
-``invalidate_compiled_step`` test pins the executable-release fix for the
-PERF.md mid-suite wedge.
+Donation is verified through the analysis layer (the ``donation`` pass
+checks every declared donated arg is aliased in the compiled module —
+``engine.analysis_report()``), with ONE legacy functional cross-check kept:
+the step consumes its input buffers, observed via ``is_deleted`` (if the
+pass and the runtime ever disagree, the pass is wrong). The retrace guard
+asserts ≤1 compile of the step programs across a 5-step loop via the
+counters, and the ``invalidate_compiled_step`` test pins the
+executable-release fix for the PERF.md mid-suite wedge.
 """
 
 import jax
@@ -35,10 +36,12 @@ def _engine(**over):
 
 
 def test_step_consumes_donated_state(eight_devices):
-    """Full-state donation, observed functionally: after an optimizer step,
-    every pre-step state buffer (params, master, opt_state, grad_acc,
-    scale_state) is deleted — XLA reused it in place instead of
-    double-buffering the training state."""
+    """LEGACY functional cross-check for the ``donation`` analysis pass:
+    after an optimizer step, every pre-step state buffer (params, master,
+    opt_state, grad_acc, scale_state) is deleted — XLA reused it in place
+    instead of double-buffering the training state. Kept deliberately
+    runtime-observed (is_deleted) so a bug in the static pass cannot
+    silently blind both checks."""
     engine = _engine(gradient_accumulation_steps=2)
     batch = step_batch(batch_size=16)
     train_steps_micro(engine, batch, 1)  # init + first window
@@ -54,38 +57,32 @@ def test_step_consumes_donated_state(eight_devices):
         assert buf.is_deleted(), f"{name} buffer survived the step (not donated)"
 
 
-def test_fused_step_consumes_donated_state(eight_devices):
-    """Same contract on the gas=1 fused forward+step program."""
+def test_fused_step_donation_verified_by_analysis(eight_devices):
+    """The gas=1 fused forward+step program's donation contract, checked
+    by the ``donation`` analysis pass (replaces the old is_deleted probe:
+    the pass reads the compiled module's alias table instead of poking
+    runtime buffer state)."""
     engine = _engine()
-    batch = step_batch(batch_size=8)
-    train_steps_micro(engine, batch, 1)
-    old = {
-        "params": jax.tree_util.tree_leaves(engine._params)[0],
-        "master": jax.tree_util.tree_leaves(engine._master)[0],
-        "opt_state": jax.tree_util.tree_leaves(engine._opt_state)[0],
-        "scale": engine._scale_state.scale,
-    }
-    train_steps_micro(engine, batch, 1)
-    for name, buf in old.items():
-        assert buf.is_deleted(), f"{name} buffer survived the fused step"
+    train_steps_micro(engine, step_batch(batch_size=8), 1)
+    rep = engine.analysis_report(programs=["fused_step"], passes=["donation"])
+    don = rep["programs"]["fused_step"]["passes"]["donation"]
+    assert don["ok"], don["violations"]
+    assert don["summary"]["declared_donations"] >= 4  # params+master+opt+scale
+    assert don["summary"].get("unhonored", 0) == 0
+    assert rep["totals"]["donation_verified"] is True
 
 
 def test_step_program_aliases_donated_inputs(eight_devices):
-    """Structural check on the compiled step: donation shows up as
-    input/output aliases (in-place update), not as fresh output buffers."""
+    """Structural check on the compiled unfused step, via the donation
+    pass (replaces the hand-rolled lower().compile() + as_text() grep):
+    every declared donated arg is aliased, zero bytes double-buffered."""
     engine = _engine(gradient_accumulation_steps=2)
     train_steps_micro(engine, step_batch(batch_size=16), 1)
-    compiled = engine._jit_step.lower(
-        engine._params,
-        engine._master,
-        engine._opt_state,
-        engine._grad_acc,
-        engine._scale_state,
-        1e-2,
-    ).compile()
-    assert "input_output_alias" in compiled.as_text()
-    mem = compiled.memory_analysis()
-    assert mem is not None and mem.alias_size_in_bytes > 0
+    rep = engine.analysis_report(programs=["step"], passes=["donation"])
+    don = rep["programs"]["step"]["passes"]["donation"]
+    assert don["ok"], don["violations"]
+    assert don["summary"]["declared_donated_bytes"] > 0
+    assert don["summary"].get("double_buffered_bytes", 0) == 0
 
 
 def test_retrace_guard_unfused_five_steps(eight_devices):

@@ -114,6 +114,23 @@ def test_single_dispatch_per_step_gas4(eight_devices):
     assert stats["step"]["dispatches"] == 0, stats
 
 
+def test_fused_accum_verified_by_analysis_passes(eight_devices):
+    """The PR-1 guarantees, checked statically instead of ad hoc: the fused
+    scan program donates-and-aliases the full state tuple (what the old
+    is_deleted probes observed at runtime), contains no host callback, and
+    its grad-reduction collectives are on the static schedule."""
+    engine = _engine(4, fuse=True, bf16={"enabled": True})
+    train_steps_batch(engine, _full_batch(4), 2)
+    rep = engine.analysis_report(programs=["fused_accum_step"])
+    entry = rep["programs"]["fused_accum_step"]["passes"]
+    assert entry["donation"]["ok"], entry["donation"]["violations"]
+    assert entry["donation"]["summary"].get("double_buffered_bytes", 0) == 0
+    assert entry["host_transfer"]["ok"], entry["host_transfer"]["violations"]
+    assert entry["dtype_promotion"]["ok"], entry["dtype_promotion"]["violations"]
+    assert entry["collectives"]["summary"]["total_count"] >= 1  # dp grad reduce
+    assert rep["totals"]["donation_verified"] is True
+
+
 def test_fused_path_keeps_no_accumulator_buffer(eight_devices):
     """The scan carries the accumulator inside the program; the engine holds
     no HBM accumulation buffer (that is the memory headroom the fusion buys)."""

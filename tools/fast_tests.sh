@@ -9,8 +9,12 @@
 # before shipping cross-cutting changes; this tier is the per-commit loop.
 # Measured 2026-07-31: ~5 min, 195 tests (+22 fused/telemetry 2026-08-03,
 # +24 paged-KV serving 2026-08-03: pool allocator, paged attention parity,
-# continuous-batching vs dense token-exactness + retrace/dispatch guards).
+# continuous-batching vs dense token-exactness + retrace/dispatch guards;
+# +static-analysis gate 2026-08-03: tools/lint.sh runs the repo AST lint —
+# errors in deepspeed_tpu/ fail the tier — and the analysis pass suite,
+# red fixtures + green sweep over the real step/serving programs).
 cd "$(dirname "$0")/.." || exit 1
+sh tools/lint.sh || exit 1
 exec python -m pytest -q \
   tests/unit/runtime/test_engine.py \
   tests/unit/runtime/test_fused_grad_accum.py \
