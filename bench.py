@@ -478,10 +478,15 @@ def bench_moe_inference():
 def bench_decode_serving():
     """Config 6 (one chip): continuous-batching serving over the paged KV
     pool (``engine.serve()``) — generated tokens/s/chip on a ragged request
-    mix. ``vs_baseline`` = paged serving throughput over the dense lockstep
+    mix, speculation OFF (``value``) and ON (``spec_on_value`` +
+    ``spec_accept_rate``: n-gram drafting, one verify dispatch per round).
+    ``vs_baseline`` = paged serving throughput over the dense lockstep
     ``generate`` on the same prompts padded to one max-budget batch (≥ ~1
     means request-level batching serves ragged traffic at least as fast as
-    the fixed-shape batch that can't retire rows early)."""
+    the fixed-shape batch that can't retire rows early);
+    ``spec_vs_off`` = spec-on over spec-off (the drafter is model-free, so
+    the ratio tracks how much repetitive structure the mix exposes ×
+    acceptance — see PERF.md round 9 for the expected-speedup math)."""
     import time as _time
 
     import jax.numpy as jnp
@@ -492,7 +497,7 @@ def bench_decode_serving():
     from deepspeed_tpu.models.config import TransformerConfig
 
     if TINY:
-        n_req, prompt_len, max_new = 6, 12, 8
+        n_req, prompt_len, max_new = 6, 12, 24
         mcfg = TransformerConfig(
             vocab_size=1024, hidden_size=128, num_layers=2, num_heads=4,
             num_kv_heads=2, max_seq_len=128, norm="rmsnorm", position="rope",
@@ -512,8 +517,17 @@ def bench_decode_serving():
     mesh_mod.reset_topology()
     engine = ds.init_inference(TransformerLM(mcfg), dtype="bf16", paged_kv=paged)
     rs = np.random.RandomState(SEED)
-    prompts = [rs.randint(0, mcfg.vocab_size, (prompt_len,)).astype(np.int32)
-               for _ in range(n_req)]
+    # half of each prompt is a tiled motif: serving traffic (code, templated
+    # text) has repetitive spans the n-gram drafter can exploit; the random
+    # half keeps the prefix from being a degenerate single pattern
+    def _prompt():
+        m = max(2, prompt_len // 32)  # short enough to repeat in the tail
+        motif = rs.randint(0, mcfg.vocab_size, (m,)).astype(np.int32)
+        head = rs.randint(0, mcfg.vocab_size, (prompt_len // 2,)).astype(np.int32)
+        tail = np.tile(motif, -(-(prompt_len - head.size) // m))[: prompt_len - head.size]
+        return np.concatenate([head, tail])
+
+    prompts = [_prompt() for _ in range(n_req)]
     toks = np.stack(prompts)
     engine.init_params(toks)
     engine._ds_config = mcfg  # flagship family: take the KV-cached decode path
@@ -528,8 +542,28 @@ def bench_decode_serving():
 
     timed_serve()  # compile every bucket/chunk program
     paged_tps = timed_serve()
+    # speculation ON through the same engine/telemetry: the server is
+    # rebuilt from the flipped knob, verify programs compile once, and the
+    # second pass is the measured one
+    engine._config.spec_decode.enable = True
+    engine._paged_server = None
+    timed_serve()  # compile every (bucket, K) verify program
+    pre = dict(engine._paged_server.stats)  # counters cover the warm-up too
+    spec_tps = timed_serve()
+    post = engine._paged_server.stats
+    rounds = post["spec_rounds"] - pre["spec_rounds"]
+    drafted = post["spec_drafted"] - pre["spec_drafted"]
+    accepted = post["spec_accepted"] - pre["spec_accepted"]
+    spec_stats = {  # deltas of the MEASURED pass only
+        "spec_rounds": rounds,
+        "spec_accept_rate": accepted / drafted if drafted else 0.0,
+        "spec_mean_accepted_per_round": accepted / rounds if rounds else 0.0,
+    }
+    engine._config.spec_decode.enable = False
+    engine._paged_server = None
     # snapshot BEFORE the dense baseline runs: the record's compile/analysis
-    # fields must describe the paged serving programs, not kv_decode_loop
+    # fields must describe the paged serving programs (decode + prefill +
+    # verify), not kv_decode_loop
     compile_fields = _compile_fields(engine)
     compile_fields.update(_analysis_fields(engine))
 
@@ -546,6 +580,14 @@ def bench_decode_serving():
         "value": round(paged_tps, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(paged_tps / dense_tps, 4),
+        # speculative serving: same metric with n-gram draft-and-verify on
+        "spec_on_value": round(spec_tps, 1),
+        "spec_vs_off": round(spec_tps / paged_tps, 4),
+        "spec_accept_rate": round(spec_stats.get("spec_accept_rate", 0.0), 4),
+        "spec_rounds": spec_stats.get("spec_rounds", 0),
+        "spec_mean_accepted_per_round": round(
+            spec_stats.get("spec_mean_accepted_per_round", 0.0), 3
+        ),
     }
     rec.update(compile_fields)
     return rec

@@ -18,6 +18,12 @@ Rules (each one traces back to a real incident in PERF.md / PR history):
   call without ``donate_argnums`` whose wrapped function takes a
   buffer-named parameter (grad_acc/opt_state/master/cache/pages/...):
   likely double-buffering a state-sized array.
+* **DS-R005 host-transfer-in-serving-loop** — ``jax.device_get`` /
+  ``.item()`` / ``np.asarray``-on-a-device-value inside the serving step
+  loop (the step/round methods of a ``*Server`` / ``*Scheduler`` class):
+  every fetch beyond the one budgeted token fetch per dispatch adds a
+  synchronous tunnel RTT (~2 ms, PERF.md) to EVERY serving round. The
+  sanctioned single fetch per dispatch carries a pragma.
 
 Suppression: append ``# lint: allow(DS-RXXX)`` (or ``# noqa: DS-RXXX``) to
 the offending line. Findings in ``tests/`` are always downgraded to
@@ -37,8 +43,21 @@ RULES = {
     "DS-R002": "host sync on a traced value inside a jitted function",
     "DS-R003": "shape-dependent python branch inside a jitted function",
     "DS-R004": "jitted function with buffer-named args and no donate_argnums",
+    "DS-R005": "host transfer inside the serving step loop (hot path)",
 }
 _WARN_ONLY = {"DS-R003", "DS-R004"}
+
+# DS-R005 scope: the per-round methods of a serving scheduler class — the
+# code that runs between every device dispatch while requests stream. A
+# class qualifies only when it BOTH matches the name pattern and defines a
+# serving-specific round method, so host-only training-side schedulers
+# (curriculum / random-LTD / compression `step()`s) stay out of scope.
+_HOT_CLASS = re.compile(r"(Server|Scheduler)$")
+_SERVING_FN = re.compile(r"^_?((plain_)?(decode|prefill|verify|spec)_(step|round)|serve)$")
+_HOT_FN = re.compile(
+    r"^_?((plain_)?(decode|prefill|verify|spec)_(step|round)|step|run|serve)$"
+)
+_NP_CASTS = ("np.asarray", "np.array", "numpy.asarray", "numpy.array", "onp.asarray")
 
 _CACHEY = re.compile(
     r"(cache|page|pool|buffer|^kv$|^k$|^v$|^k_|^v_|_kv$|kv_)", re.IGNORECASE
@@ -262,6 +281,48 @@ def lint_source(src: str, path: str = "<string>") -> List[LintFinding]:
                         "DS-R003",
                         "shape-dependent python branch inside a jitted function "
                         "(each new shape recompiles)",
+                    )
+
+    # ---- DS-R005: host transfers in the serving hot loop --------------
+    for cls in ast.walk(tree):
+        if not (isinstance(cls, ast.ClassDef) and _HOT_CLASS.search(cls.name)):
+            continue
+        if not any(
+            isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and _SERVING_FN.match(m.name)
+            for m in cls.body
+        ):
+            continue  # a host-only scheduler, not the serving loop
+        for fn in cls.body:
+            if not (
+                isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and _HOT_FN.match(fn.name)
+            ):
+                continue
+            where = f"serving hot path {cls.name}.{fn.name}"
+            for n in ast.walk(fn):
+                if not isinstance(n, ast.Call):
+                    continue
+                fname = _dotted(n.func)
+                if fname in ("jax.device_get", "device_get"):
+                    add(n.lineno, "DS-R005", f"jax.device_get in {where}")
+                elif (
+                    (fname == "item" or fname.endswith(".item"))
+                    and isinstance(n.func, ast.Attribute)
+                    and not n.args
+                ):
+                    add(n.lineno, "DS-R005", f".item() in {where}")
+                elif fname in _NP_CASTS and n.args and isinstance(
+                    # literals (lists/tuples/constants) build host arrays;
+                    # names/attributes/calls/subscripts can hide a device
+                    # value whose np conversion is a blocking transfer
+                    n.args[0], (ast.Name, ast.Attribute, ast.Call, ast.Subscript)
+                ):
+                    add(
+                        n.lineno,
+                        "DS-R005",
+                        f"{fname} on a possible device value in {where} "
+                        "(one fetch per dispatch is the budget)",
                     )
 
     # ---- DS-R004: jit call sites without donation ---------------------

@@ -6,3 +6,4 @@ from deepspeed_tpu.inference.kv_pool import (  # noqa: F401
     init_paged_cache,
 )
 from deepspeed_tpu.inference.scheduler import PagedServer, Request  # noqa: F401
+from deepspeed_tpu.inference.spec_decode import Drafter, NGramDrafter  # noqa: F401

@@ -73,7 +73,9 @@ class PagedKVConfig(DeepSpeedConfigModel):
     (``max_slots × ceil(max_seq_len / page_size) + 1``, preemption-free);
     set it lower to oversubscribe and trade HBM for recompute preemptions.
     Compiled-program count is ``len(slot_buckets) + 1``: one decode program
-    per bucket, one prefill program per chunk size.
+    per bucket, one prefill program per chunk size — plus
+    ``len(slot_buckets) × len(spec_lens)`` verify programs when
+    ``spec_decode.enable`` is set.
     """
 
     enabled: bool = True
@@ -84,6 +86,25 @@ class PagedKVConfig(DeepSpeedConfigModel):
     max_seq_len: int = 0  # 0 = the model config's max_seq_len
     prefill_chunk: int = 32  # prompt tokens per interleaved prefill dispatch
     attn_impl: str = "auto"  # auto | pallas | xla (decode attention backend)
+
+
+class SpecDecodeConfig(DeepSpeedConfigModel):
+    """Speculative-decoding knobs for paged serving (``engine.serve()``).
+
+    Each speculative round drafts up to ``max_draft`` tokens per request
+    host-side (``inference/spec_decode.py``: model-free n-gram /
+    prompt-lookup of order ``ngram_order``) and verifies them in ONE
+    device dispatch; greedy outputs stay byte-identical to
+    speculation-off serving. ``spec_lens`` are the compiled verify widths
+    K (a round uses the smallest K covering its longest draft); program
+    count is bounded by ``len(slot_buckets) × len(spec_lens)``. With
+    ``spec_lens = []`` the single width ``max_draft`` is compiled.
+    """
+
+    enable: bool = False
+    max_draft: int = 4  # drafted tokens per request per round (the K cap)
+    ngram_order: int = 3  # longest suffix n-gram the drafter looks up
+    spec_lens: list = Field(default_factory=list)  # [] = [max_draft]
 
 
 class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
@@ -98,6 +119,7 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     moe: DeepSpeedMoEConfig = Field(default_factory=DeepSpeedMoEConfig)
     quant: QuantizationConfig = Field(default_factory=QuantizationConfig)
     paged_kv: PagedKVConfig = Field(default_factory=PagedKVConfig)
+    spec_decode: SpecDecodeConfig = Field(default_factory=SpecDecodeConfig)
     analysis: AnalysisConfig = Field(default_factory=AnalysisConfig)
     checkpoint: Optional[Any] = None
     base_dir: str = ""

@@ -561,21 +561,30 @@ def beam_generate(
 
 # --- paged (block-table) serving programs ----------------------------------
 # The continuous-batching scheduler (inference/scheduler.py) drives these:
-# per decode step ONE dispatch of a slot-bucket-sized program; per prompt
-# chunk one dispatch of a fixed-chunk prefill program. Compiled-program
-# count is bounded by (slot buckets + 1 chunk size), never by traffic.
+# per decode step ONE dispatch of a slot-bucket-sized program (or, with
+# speculation, ONE dispatch of a (bucket, K)-shaped verify program that
+# scores K drafted tokens plus the bonus token together); per prompt chunk
+# one dispatch of a fixed-chunk prefill program. Compiled-program count is
+# bounded by (slot buckets × spec lengths + slot buckets + chunk sizes),
+# never by traffic.
 
 
-def _scatter_pages(pages_l, vals, page_table, positions, page_size):
+def _scatter_pages(pages_l, vals, page_table, positions, page_size, valid=None):
     """Write [B, T, NKV, D] new k/v rows into one layer's page pool
     [NP, NKV, P, D] at absolute ``positions`` [B, T] through the page table
     [B, MAXP]. Sentinel table entries (< 0, i.e. unallocated/dead rows)
     clamp onto the reserved trash page 0, so padded bucket rows and prompt
-    pad tails write garbage only where nothing lives."""
+    pad tails write garbage only where nothing lives. ``valid`` (bool
+    [B, T], optional) force-redirects masked positions onto the trash page
+    regardless of the table: the verify program's pad draft slots sit past
+    a row's ensured pages, where ``positions // page_size`` could alias a
+    LIVE page after the maxp clamp."""
     NP = pages_l.shape[0]
     maxp = page_table.shape[1]
     slot = jnp.clip(positions // page_size, 0, maxp - 1)
     pid = jnp.clip(jnp.take_along_axis(page_table, slot, axis=1), 0, NP - 1)
+    if valid is not None:
+        pid = jnp.where(valid, pid, 0)  # page 0 = the reserved trash page
     off = positions % page_size
     # advanced-index scatter: (pid, off) broadcast to [B, T] and land first,
     # giving the [B, T, NKV, D] update window vals fills exactly
@@ -583,12 +592,15 @@ def _scatter_pages(pages_l, vals, page_table, positions, page_size):
 
 
 def _paged_forward(cfg, params, tokens, k_pages, v_pages, page_table, positions_b,
-                   attn_lens, attn_impl):
+                   attn_lens, attn_impl, write_valid=None, prefill_kv_lens=None):
     """Forward [B, T] tokens against the paged cache: scatter each token's
     k/v into its page, then attend — single-token rows (T == 1) through the
     paged decode kernel with live lengths ``attn_lens``, chunks through the
-    causal prefill attention (mask from ``positions_b``). Returns
-    (logits [B, T, V], new_k_pages, new_v_pages)."""
+    causal prefill attention (mask from ``positions_b``). ``write_valid``
+    ([B, T] bool) redirects masked positions' k/v writes to the trash page;
+    ``prefill_kv_lens`` ([B]) additionally bounds the causal attention to
+    each row's live kv prefix (the verify program's pad-slot safety).
+    Returns (logits [B, T, V], new_k_pages, new_v_pages)."""
     from deepspeed_tpu.ops.transformer.paged_attention import (
         paged_decode_attention,
         paged_prefill_attention,
@@ -608,8 +620,10 @@ def _paged_forward(cfg, params, tokens, k_pages, v_pages, page_table, positions_
         if cfg.position == "rope":
             q = _rope(q, positions_b, cfg.rope_theta, cfg.rope_dim)
             k_new = _rope(k_new, positions_b, cfg.rope_theta, cfg.rope_dim)
-        kp_l = _scatter_pages(kp_l, k_new.astype(dtype), page_table, positions_b, P)
-        vp_l = _scatter_pages(vp_l, v_new.astype(dtype), page_table, positions_b, P)
+        kp_l = _scatter_pages(kp_l, k_new.astype(dtype), page_table, positions_b, P,
+                              valid=write_valid)
+        vp_l = _scatter_pages(vp_l, v_new.astype(dtype), page_table, positions_b, P,
+                              valid=write_valid)
         # attn_lens discriminates decode from prefill: a prefill_chunk=1
         # program also has T == 1 but must take the causal-mask path
         if T == 1 and attn_lens is not None:
@@ -618,7 +632,8 @@ def _paged_forward(cfg, params, tokens, k_pages, v_pages, page_table, positions_
             )[:, None]
         else:
             attn = paged_prefill_attention(
-                q, kp_l, vp_l, page_table, positions_b, scale=scale
+                q, kp_l, vp_l, page_table, positions_b, scale=scale,
+                kv_lens=prefill_kv_lens,
             )
         x = _post_attention(cfg, p, x, attn)
         return x, (kp_l, vp_l)
@@ -669,9 +684,11 @@ def build_paged_prefill(cfg, chunk: int, page_size: int, attn_impl: str = "auto"
     start [1], last_idx) -> (next_token [1], k_pages, v_pages)``: scatters
     the chunk's k/v at ``start..start+C-1``, attends causally, and returns
     the greedy token after position ``last_idx`` (traced, so ragged final
-    chunks never retrace). Short final chunks arrive padded; pad positions
-    write beyond the live length or onto the trash page and are causally
-    invisible to every real token."""
+    chunks never retrace). Short final chunks arrive padded; pad slots
+    (index > ``last_idx``) redirect their writes to the trash page — a pad
+    position past the table width would otherwise clamp onto the LAST live
+    column and overwrite real prompt k/v — and are causally invisible to
+    every real token."""
     if cfg.position == "alibi":
         raise NotImplementedError("paged serving does not support alibi attention biases")
     key = (_cfg_key(cfg), int(chunk), int(page_size), attn_impl, _telemetry_uid(telemetry))
@@ -681,14 +698,91 @@ def build_paged_prefill(cfg, chunk: int, page_size: int, attn_impl: str = "auto"
 
     def _prefill(params, tokens, k_pages, v_pages, page_table, start, last_idx):
         T = tokens.shape[1]
-        positions_b = start[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+        offs = jnp.arange(T, dtype=jnp.int32)
+        positions_b = start[:, None] + offs[None, :]
+        valid = (offs <= last_idx)[None, :]  # pad tail -> trash page
         logits, new_k, new_v = _paged_forward(
             cfg, params, tokens, k_pages, v_pages, page_table, positions_b,
-            None, attn_impl,
+            None, attn_impl, write_valid=valid,
         )
         last = jax.lax.dynamic_index_in_dim(logits, last_idx, axis=1, keepdims=False)
         return jnp.argmax(last, axis=-1).astype(jnp.int32), new_k, new_v
 
     fn = _jit(_prefill, telemetry, f"paged_prefill_c{int(chunk)}", donate_argnums=(2, 3))
     _paged_prefill_cache[key] = fn
+    return fn
+
+
+_paged_verify_cache: Dict[Tuple, Any] = {}
+
+
+def build_paged_verify_step(cfg, bucket: int, K: int, page_size: int,
+                            attn_impl: str = "auto", telemetry=None):
+    """One-dispatch speculative draft-and-verify step for a ``bucket``-row
+    slot batch and draft width ``K``.
+
+    ``verify(params, tokens [B, K+1], k_pages, v_pages, page_table [B, MAXP],
+    lengths [B], draft_lens [B]) -> (out [B, K+2], k_pages, v_pages)``.
+    Row b's ``tokens`` are its pending token followed by up to K host-drafted
+    tokens (garbage past ``draft_lens[b]``). The program scatters k/v for
+    every position ``lengths[b] + j`` (pad slots redirect to the trash page),
+    scores all K+1 positions in ONE causal chunk-prefill attention pass over
+    the row's pages, and resolves the speculation in-program:
+    ``out[:, 0]`` is the accepted-prefix length ``n`` — the count of leading
+    drafts that equal the model's own greedy argmax, bounded by
+    ``draft_lens`` — and ``out[:, 1:]`` the greedy token after each prefix,
+    so the round emits ``out[b, 1 : n+2]`` (n accepted drafts + the
+    bonus/correction token), byte-identical to n+1 sequential decode steps.
+    The host rolls the rejected tail's pages back via ``PagePool.rollback``.
+
+    Pages are donated; the packed [B, K+2] fetch is the round's only host
+    traffic. Compiled once per (bucket, K); the scheduler bounds total
+    verify programs by ``len(slot_buckets) × len(spec_lens)``.
+
+    Exactness caveat: verify scores through the XLA chunk attention, so
+    byte-identical spec-on/spec-off streams are guaranteed when the plain
+    decode steps use the same backend (``attn_impl="xla"``, the tested
+    config). Under ``"auto"`` on TPU the plain steps run the Pallas decode
+    kernel — mathematically the same scores, but an argmax near-tie could
+    in principle resolve differently across the two lowerings.
+    """
+    if cfg.position == "alibi":
+        raise NotImplementedError("paged serving does not support alibi attention biases")
+    if K < 1:
+        raise ValueError(f"speculative verify needs K >= 1 drafted slots, got {K}")
+    key = (_cfg_key(cfg), int(bucket), int(K), int(page_size), attn_impl,
+           _telemetry_uid(telemetry))
+    fn = _paged_verify_cache.get(key)
+    if fn is not None:
+        return fn
+
+    def _verify(params, tokens, k_pages, v_pages, page_table, lengths, draft_lens):
+        T = K + 1
+        offs = jnp.arange(T, dtype=jnp.int32)
+        positions_b = lengths[:, None] + offs[None, :]
+        # pad slots (j > draft_lens[b]) hold garbage tokens whose positions
+        # may reach past the row's ensured pages — their writes go to the
+        # trash page and their kv rows are masked out of the attention
+        valid = offs[None, :] <= draft_lens[:, None]
+        kv_lens = jnp.where(lengths > 0, lengths + draft_lens + 1, 0)
+        logits, new_k, new_v = _paged_forward(
+            cfg, params, tokens, k_pages, v_pages, page_table, positions_b,
+            None, attn_impl, write_valid=valid, prefill_kv_lens=kv_lens,
+        )
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, K+1]
+        # draft j is accepted iff every draft before it matched the model's
+        # greedy choice for its position (argmax-compare: greedy outputs are
+        # byte-identical to non-speculative decode)
+        matches = (tokens[:, 1:] == greedy[:, :-1]) & (
+            jnp.arange(K, dtype=jnp.int32)[None, :] < draft_lens[:, None]
+        )
+        accepted = jnp.sum(jnp.cumprod(matches.astype(jnp.int32), axis=1), axis=1)
+        packed = jnp.concatenate([accepted[:, None].astype(jnp.int32), greedy], axis=1)
+        return packed, new_k, new_v
+
+    fn = _jit(
+        _verify, telemetry, f"paged_verify_b{int(bucket)}_k{int(K)}",
+        donate_argnums=(2, 3),
+    )
+    _paged_verify_cache[key] = fn
     return fn

@@ -549,18 +549,21 @@ class InferenceEngine:
             attn_impl=pcfg.attn_impl,
             dtype=self.dtype,
             telemetry=self._telemetry,
+            spec_decode=self._config.spec_decode,
         )
 
     def serve(self, prompts, max_new_tokens=32, eos_token_id=None):
         """Continuous-batching greedy generation over the paged KV pool:
         requests are admitted/evicted every step, prompts prefill in chunks
         interleaved with decode, and each decode step is ONE dispatch of a
-        slot-bucket-shaped program (``inference/scheduler.py``). Accepts a
-        list of 1-D prompts (ragged — no padding to a common length) and a
-        scalar or per-request ``max_new_tokens``; returns one 1-D output
-        array per request in submission order. The server (and its page
-        pool) persists across calls, sized by the ``paged_kv`` config
-        section."""
+        slot-bucket-shaped program (``inference/scheduler.py``). With
+        ``inference.spec_decode.enable`` the steps become speculative
+        rounds — host-side n-gram drafting plus a single draft-and-verify
+        dispatch per round, token-exact under greedy. Accepts a list of 1-D
+        prompts (ragged — no padding to a common length) and a scalar or
+        per-request ``max_new_tokens``; returns one 1-D output array per
+        request in submission order. The server (and its page pool)
+        persists across calls, sized by the ``paged_kv`` config section."""
         if self._paged_server is None:
             self._paged_server = self._build_paged_server()
         return self._paged_server.serve(
@@ -568,19 +571,14 @@ class InferenceEngine:
         )
 
     def serve_stats(self):
-        """Scheduler counters of the live paged server (admitted, preempted,
-        finished, prefill_chunks, decode_steps) plus pool occupancy."""
+        """Observability of the live paged server: scheduler counters
+        (admitted, preempted, finished, prefill_chunks, decode_steps,
+        spec_rounds), speculation quality (``spec_accept_rate``,
+        ``spec_mean_accepted_per_round``, the ``spec_accept_hist`` draft-hit
+        histogram), and pool occupancy/utilization."""
         if self._paged_server is None:
             return {}
-        stats = dict(self._paged_server.stats)
-        pool = self._paged_server.pool
-        stats.update(
-            live_tokens=pool.live_tokens(),
-            used_pages=pool.used_pages(),
-            free_pages=pool.free_pages(),
-            live_hbm_bytes=pool.live_hbm_bytes(),
-        )
-        return stats
+        return self._paged_server.serve_stats()
 
     def _zero_generate(self, input_ids, max_new_tokens, eos_token_id, pad_token_id,
                        temperature=0.0, top_k=0, top_p=1.0):

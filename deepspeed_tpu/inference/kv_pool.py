@@ -185,6 +185,31 @@ class PagePool:
         )
         self.seq_lens[slot] = new_len
 
+    def rollback(self, slot: int, n_tokens: int) -> int:
+        """Un-write the last ``n_tokens`` of ``slot`` — speculative decode's
+        rejected draft tail: shrink the live length and return every page
+        past the new length to the free list (LIFO, so the tail pages are
+        the first reused). The data in the rolled-back region is NOT
+        cleared — the length mask makes it invisible, and the next write at
+        those positions overwrites it. Returns how many pages came back."""
+        n_tokens = int(n_tokens)
+        new_len = int(self.seq_lens[slot]) - n_tokens
+        if n_tokens < 0 or new_len < 0:
+            raise ValueError(
+                f"rollback({slot}, {n_tokens}): slot holds "
+                f"{int(self.seq_lens[slot])} tokens"
+            )
+        self.seq_lens[slot] = new_len
+        keep = self.pages_for(new_len)
+        freed = 0
+        while self._owned[slot] > keep:
+            self._owned[slot] -= 1
+            i = int(self._owned[slot])
+            self._free.append(int(self.page_table[slot, i]))
+            self.page_table[slot, i] = -1
+            freed += 1
+        return freed
+
     def free_slot(self, slot: int) -> int:
         """Release the slot and return its pages to the pool; returns how
         many pages came back."""

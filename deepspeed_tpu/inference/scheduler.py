@@ -13,14 +13,23 @@ vLLM style):
 * when the pool runs dry the **youngest** running request is preempted
   (pages freed, request requeued); greedy decoding makes its recomputed
   continuation token-exact, so preemption is invisible in the output;
-* compiled-program count is bounded by the **slot-count buckets**: each
-  decode step dispatches ONE program shaped to the smallest bucket covering
-  the running set, and each prompt chunk one fixed-chunk prefill program.
-  Steady state is one dispatch per decode step, ≤1 compile per bucket —
-  enforced by the serving tests via the engine's compile telemetry.
+* with **speculative decoding** enabled, each round first asks a host-side
+  ``Drafter`` (``inference/spec_decode.py``) for up to K plausible next
+  tokens per running request, then verifies drafts + bonus token in ONE
+  dispatch of a (bucket, K)-shaped program — the accepted prefix advances
+  ``mean accepted + 1`` tokens per dispatch, the rejected tail's pages roll
+  back to the free list, and greedy outputs stay byte-identical to
+  speculation-off serving (the verify program argmax-compares in-program);
+* compiled-program count is bounded by the **slot-count buckets** (× the
+  **spec lengths** when speculating): each round dispatches ONE program
+  shaped to the smallest bucket covering the running set, and each prompt
+  chunk one fixed-chunk prefill program. Steady state is one dispatch per
+  round, ≤1 compile per (bucket[, spec length]) — enforced by the serving
+  tests via the engine's compile telemetry.
 
 ``InferenceEngine.serve()`` (``inference/engine.py``) owns a ``PagedServer``
-configured from the ``inference.paged_kv`` knobs.
+configured from the ``inference.paged_kv`` + ``inference.spec_decode``
+knobs.
 """
 
 from __future__ import annotations
@@ -31,9 +40,23 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from deepspeed_tpu.inference.decode import build_paged_decode_step, build_paged_prefill
+from deepspeed_tpu.inference.decode import (
+    build_paged_decode_step,
+    build_paged_prefill,
+    build_paged_verify_step,
+)
 from deepspeed_tpu.inference.kv_pool import PagedKVCache, PagePool
+from deepspeed_tpu.inference.spec_decode import Drafter, NGramDrafter
 from deepspeed_tpu.models.config import TransformerConfig
+
+
+def _spec_knob(spec, name, default):
+    """Read a knob off a SpecDecodeConfig, a plain dict, or None."""
+    if spec is None:
+        return default
+    if isinstance(spec, dict):
+        return spec.get(name, default)
+    return getattr(spec, name, default)
 
 
 @dataclass
@@ -50,17 +73,33 @@ class Request:
     pending: Optional[int] = None  # sampled but not yet written token
     done: bool = False
     admissions: int = 0  # > 1 means the request was preempted and resumed
+    # capacity-doubling context buffer: context() sits on the serving hot
+    # path (drafting reads it every speculative round), so appending the
+    # newly emitted tokens must not re-concatenate the whole history
+    _ctx_buf: Optional[np.ndarray] = field(default=None, repr=False)
+    _ctx_len: int = field(default=0, repr=False)
 
     def context(self) -> np.ndarray:
         """Tokens to (re)compute on admission: the prompt plus everything
         already emitted — after a preemption the resumed prefill re-derives
-        the exact greedy continuation."""
-        return np.concatenate(
-            [self.prompt, np.asarray(self.generated, np.int32)]
-        ).astype(np.int32)
+        the exact greedy continuation. Returns a read-only view; amortized
+        cost is O(tokens emitted since the last call)."""
+        n = self.prompt.size + len(self.generated)
+        buf = self._ctx_buf
+        if buf is None or buf.size < n:
+            grown = np.empty(max(16, 2 * n), np.int32)
+            grown[: self.prompt.size] = self.prompt
+            grown[self.prompt.size : n] = self.generated
+            self._ctx_buf = buf = grown
+        elif self._ctx_len < n:
+            buf[self._ctx_len : n] = self.generated[self._ctx_len - self.prompt.size :]
+        self._ctx_len = n
+        view = buf[:n]
+        view.flags.writeable = False  # a mutating Drafter must not corrupt
+        return view                   # the re-prefill source after preemption
 
     def output(self) -> np.ndarray:
-        return self.context()
+        return self.context().copy()
 
 
 def _default_buckets(max_slots: int) -> List[int]:
@@ -90,12 +129,45 @@ class PagedServer:
         attn_impl: str = "auto",
         dtype=None,
         telemetry=None,
+        spec_decode=None,
+        drafter: Optional[Drafter] = None,
     ):
         self.cfg = cfg
         self.params = params
         self.prefill_chunk = int(prefill_chunk)
         self.attn_impl = attn_impl
         self.telemetry = telemetry
+        # speculation: a SpecDecodeConfig / dict of knobs, or an explicit
+        # Drafter instance (tests inject oracles this way) — either enables
+        self.max_draft = int(_spec_knob(spec_decode, "max_draft", 4))
+        lens = [int(l) for l in (_spec_knob(spec_decode, "spec_lens", None) or [])]
+        self.spec_lens = sorted(set(lens)) or [self.max_draft]
+        if drafter is None and _spec_knob(spec_decode, "enable", False):
+            drafter = NGramDrafter(
+                ngram_order=int(_spec_knob(spec_decode, "ngram_order", 3))
+            )
+        self.drafter = drafter
+        if self.drafter is not None and (
+            self.max_draft < 1 or any(l < 1 for l in self.spec_lens)
+        ):
+            raise ValueError(
+                f"speculation needs max_draft >= 1 and spec_lens >= 1, got "
+                f"max_draft={self.max_draft} spec_lens={self.spec_lens}"
+            )
+        if self.drafter is not None and attn_impl == "auto":
+            from deepspeed_tpu.utils.logging import logger
+
+            # byte-identical spec-on/spec-off streams are guaranteed when
+            # decode and verify score through one backend; "auto" on TPU
+            # mixes the Pallas decode kernel with XLA verify scoring, where
+            # an argmax near-tie could in principle resolve differently
+            logger.warning(
+                "speculative serving with attn_impl='auto': greedy streams "
+                "are exact per attention backend; pin attn_impl='xla' for a "
+                "strict byte-identical guarantee vs speculation-off serving"
+            )
+        # drafts are clamped to the widest compiled verify program
+        self._draft_cap = min(self.max_draft, self.spec_lens[-1])
         max_seq = int(max_seq_len or cfg.max_seq_len)
         if num_pages <= 0:
             # worst-case sizing: every slot at max length, plus the trash
@@ -121,7 +193,13 @@ class PagedServer:
             "preempted": 0,
             "finished": 0,
             "prefill_chunks": 0,
-            "decode_steps": 0,
+            "decode_steps": 0,  # plain (non-speculative) decode dispatches
+            "spec_rounds": 0,  # verify dispatches (one per speculative round)
+            "spec_drafted": 0,  # draft tokens sent to verification
+            "spec_accepted": 0,  # draft tokens accepted
+            # draft-hit histogram: accept_hist[n] counts (request, round)
+            # pairs whose accepted prefix was exactly n drafts long
+            "spec_accept_hist": [0] * (self._draft_cap + 1),
         }
 
     # --- request intake -------------------------------------------------
@@ -237,18 +315,33 @@ class PagedServer:
             req.consumed = start + real
             self.stats["prefill_chunks"] += 1
             if req.consumed == ctx.size:
-                self._emit(req, int(np.asarray(tok)[0]))
+                # the chunk's single host fetch: the first generated token
+                self._emit(req, int(np.asarray(tok)[0]))  # lint: allow(DS-R005)
 
     def _decode_step(self) -> None:
         running = [r for r in self._active if r.pending is not None and not r.done]
-        # grow each running row by one position, preempting the youngest
-        # active request (prefilling or running) when the pool is dry —
-        # vLLM's recompute preemption: the victim's greedy continuation is
-        # re-derived exactly on re-admission
+        if not running:
+            return
+        if self.drafter is not None:
+            drafts = self._propose_drafts(running)
+            if any(d.size for d in drafts.values()):
+                self._verify_round(running, drafts)
+                return
+            # nothing drafted anywhere: a verify dispatch would only carry
+            # dead slots — fall through to the plain one-token program
+        self._plain_decode_step(running)
+
+    def _reserve_for_growth(self, running: List[Request], need: Dict[int, int]) -> List[Request]:
+        """Ensure every running row can write its next ``need[uid]`` tokens
+        (default 1), preempting the youngest active request (prefilling or
+        running) when the pool is dry — vLLM's recompute preemption: the
+        victim's greedy continuation is re-derived exactly on re-admission.
+        Mutates and returns ``running`` (preempted rows leave the round)."""
         idx = 0
         while idx < len(running):
             req = running[idx]
-            while not self.pool.ensure(req.slot, int(self.pool.seq_lens[req.slot]) + 1):
+            grow = need.get(req.uid, 1)
+            while not self.pool.ensure(req.slot, int(self.pool.seq_lens[req.slot]) + grow):
                 candidates = [r for r in self._active if r is not req]
                 if not candidates:
                     # unreachable while submit() validates total size, kept
@@ -266,17 +359,28 @@ class PagedServer:
                     if vi < idx:
                         idx -= 1
             idx += 1
-        if not running:
-            return
+        return running
+
+    def _dispatch_rows(self, running: List[Request]):
+        """Bucket-padded (bucket, page_table, lengths) for one dispatch —
+        rows past ``len(running)`` are dead padding (-1 tables / length 0:
+        trash-page semantics make them always safe)."""
         bucket = min(b for b in self.buckets if b >= len(running))
-        tokens = np.zeros(bucket, np.int32)
         page_table = np.full((bucket, self.pool.max_pages_per_slot), -1, np.int32)
         lengths = np.zeros(bucket, np.int32)
         rows_pt, rows_len = self.pool.rows([r.slot for r in running])
         n = len(running)
-        tokens[:n] = [r.pending for r in running]
         page_table[:n] = rows_pt
         lengths[:n] = rows_len
+        return bucket, page_table, lengths
+
+    def _plain_decode_step(self, running: List[Request]) -> None:
+        running = self._reserve_for_growth(running, {})
+        if not running:
+            return
+        bucket, page_table, lengths = self._dispatch_rows(running)
+        tokens = np.zeros(bucket, np.int32)
+        tokens[: len(running)] = [r.pending for r in running]
         decode = build_paged_decode_step(
             self.cfg, bucket, self.pool.page_size, attn_impl=self.attn_impl,
             telemetry=self.telemetry,
@@ -287,10 +391,80 @@ class PagedServer:
         )
         self.pool.cache = PagedKVCache(k_pages=new_k, v_pages=new_v)
         self.stats["decode_steps"] += 1
-        out = np.asarray(out)  # the step's single host fetch: [bucket] tokens
+        # the step's single host fetch: [bucket] tokens
+        out = np.asarray(out)  # lint: allow(DS-R005)
         for i, req in enumerate(running):
             self.pool.advance(req.slot, 1)
             self._emit(req, int(out[i]))
+
+    # --- speculative rounds ---------------------------------------------
+    def _propose_drafts(self, running: List[Request]) -> Dict[int, np.ndarray]:
+        """Host-side drafting: up to ``_draft_cap`` tokens per request,
+        clamped so drafts never outrun the request's remaining budget (the
+        bonus token always needs one slot) — which also keeps every write
+        inside ``max_seq_len``."""
+        drafts: Dict[int, np.ndarray] = {}
+        for req in running:
+            budget = req.max_new_tokens - len(req.generated)  # >= 1 while running
+            k = min(self._draft_cap, budget - 1)
+            d = np.zeros(0, np.int32)
+            if k > 0:
+                d = np.asarray(
+                    self.drafter.propose(req.uid, req.context(), k), np.int32
+                ).reshape(-1)[:k]
+            drafts[req.uid] = d
+        return drafts
+
+    def _verify_round(self, running: List[Request], drafts: Dict[int, np.ndarray]) -> None:
+        """One speculative round: reserve pages for every row's drafts +
+        bonus slot, dispatch ONE (bucket, K) verify program, emit each
+        row's accepted prefix + bonus/correction token, and roll the
+        rejected tail's pages back to the free list."""
+        need = {uid: d.size + 1 for uid, d in drafts.items()}
+        running = self._reserve_for_growth(running, need)
+        if not running:
+            return
+        d_max = max(drafts[r.uid].size for r in running)
+        # the smallest compiled width covering this round's longest draft
+        # (preemption may have evicted every drafting row — any width works)
+        K = next((l for l in self.spec_lens if l >= d_max), self.spec_lens[-1])
+        bucket, page_table, lengths = self._dispatch_rows(running)
+        tokens = np.zeros((bucket, K + 1), np.int32)
+        draft_lens = np.zeros(bucket, np.int32)
+        for i, req in enumerate(running):
+            d = drafts[req.uid]
+            tokens[i, 0] = req.pending
+            tokens[i, 1 : 1 + d.size] = d
+            draft_lens[i] = d.size
+        verify = build_paged_verify_step(
+            self.cfg, bucket, K, self.pool.page_size, attn_impl=self.attn_impl,
+            telemetry=self.telemetry,
+        )
+        out, new_k, new_v = verify(
+            self.params, tokens, self.pool.cache.k_pages, self.pool.cache.v_pages,
+            page_table, lengths, draft_lens,
+        )
+        self.pool.cache = PagedKVCache(k_pages=new_k, v_pages=new_v)
+        self.stats["spec_rounds"] += 1
+        # the round's single host fetch: [bucket, K+2] = accept count + the
+        # greedy token after each prefix
+        out = np.asarray(out)  # lint: allow(DS-R005)
+        hist = self.stats["spec_accept_hist"]
+        for i, req in enumerate(running):
+            d = int(draft_lens[i])
+            acc = int(out[i, 0])  # bounded by draft_lens in-program
+            # all d+1 written positions first, then the rejected tail rolls
+            # back — net advance is the accepted prefix + bonus token
+            self.pool.advance(req.slot, d + 1)
+            self.pool.rollback(req.slot, d - acc)
+            self.stats["spec_drafted"] += d
+            self.stats["spec_accepted"] += acc
+            if d:
+                hist[min(acc, len(hist) - 1)] += 1
+            for tok in out[i, 1 : acc + 2]:
+                self._emit(req, int(tok))
+                if req.done:  # EOS / budget inside the accepted run
+                    break
 
     # --- bookkeeping ----------------------------------------------------
     def _emit(self, req: Request, token: int) -> None:
@@ -311,6 +485,31 @@ class PagedServer:
         self._active.remove(req)
         self._results[req.uid] = req.output()
         self.stats["finished"] += 1
+        if self.drafter is not None:
+            self.drafter.drop(req.uid)
+
+    # --- observability ---------------------------------------------------
+    def serve_stats(self) -> Dict:
+        """Scheduler counters plus derived speculation observability
+        (acceptance rate, mean accepted drafts per round, draft-hit
+        histogram) and pool occupancy/utilization — the payload
+        ``InferenceEngine.serve_stats()`` surfaces and ``bench.py`` records
+        per serving config."""
+        s = dict(self.stats)
+        s["spec_accept_hist"] = list(self.stats["spec_accept_hist"])
+        drafted, rounds = s["spec_drafted"], s["spec_rounds"]
+        s["spec_accept_rate"] = s["spec_accepted"] / drafted if drafted else 0.0
+        s["spec_mean_accepted_per_round"] = (
+            s["spec_accepted"] / rounds if rounds else 0.0
+        )
+        s.update(
+            live_tokens=self.pool.live_tokens(),
+            used_pages=self.pool.used_pages(),
+            free_pages=self.pool.free_pages(),
+            live_hbm_bytes=self.pool.live_hbm_bytes(),
+            pool_utilization=self.pool.utilization(),
+        )
+        return s
 
     def _preempt(self, req: Request) -> None:
         self.pool.free_slot(req.slot)

@@ -13,10 +13,13 @@ layout:
   dim) on TPU, and to a gather-based XLA implementation everywhere else —
   interpret-mode Pallas inside a per-step serving program would dominate
   CPU-mesh test time.
-* ``paged_prefill_attention`` — a prompt chunk ``[B, T]`` attends causally
-  over its own pages (prefix + the chunk itself, already scattered in).
-  Pure XLA: chunked prefill is matmul-bound, and the gather touches only
-  the one sequence being prefilled.
+* ``paged_prefill_attention`` — a token slab ``[B, T]`` attends causally
+  over each row's own pages (prefix + the slab itself, already scattered
+  in). Pure XLA: the slab paths are matmul-bound. Two callers: chunked
+  prompt prefill (B = 1, T = chunk) and the speculative verify program
+  (B = slot bucket, T = K+1 draft-and-bonus slots), which also passes
+  per-row ``kv_lens`` so pad draft slots past a row's live prefix are
+  masked out of every score.
 
 GQA is handled by grouping — queries reshape to ``[B, NKV, G, D]`` and each
 kv head's rows are read once — so no path here (kernel or fallback) ever
@@ -119,13 +122,18 @@ def paged_prefill_attention(
     page_table: jnp.ndarray,  # [B, MAXP] int32
     q_positions: jnp.ndarray,  # [B, T] absolute positions of the chunk tokens
     scale: Optional[float] = None,
+    kv_lens: Optional[jnp.ndarray] = None,  # [B] live kv bound (incl. the slab)
 ) -> jnp.ndarray:
-    """Causal chunk attention over the sequence's own pages: query at
-    absolute position p sees kv positions <= p (the chunk's k/v have already
-    been scattered into the pages, so the chunk attends to itself too).
-    Positions past a chunk's real end (pad tail) produce garbage rows the
+    """Causal slab attention over each sequence's own pages: query at
+    absolute position p sees kv positions <= p (the slab's k/v have already
+    been scattered into the pages, so the slab attends to itself too).
+    Positions past a slab's real end (pad tail) produce garbage rows the
     caller ignores — their writes land on the trash page and their reads are
-    causally bounded, so they never contaminate live positions."""
+    causally bounded, so they never contaminate live positions. ``kv_lens``
+    additionally caps every row's visible kv range (the verify program's
+    pad slots sit ABOVE live positions, where causality alone would let
+    them read unwritten pages); rows with ``kv_lens == 0`` (dead bucket
+    padding) return exact zeros."""
     B, T, NH, D = q.shape
     NP, NKV, P, _ = k_pages.shape
     if NH % NKV:
@@ -138,8 +146,14 @@ def paged_prefill_attention(
     qg = q.reshape(B, T, NKV, G, D)
     scores = jnp.einsum("btkgd,bskd->bkgts", qg, k).astype(jnp.float32) * scale_f
     kv_pos = jnp.arange(S, dtype=jnp.int32)
-    causal = q_positions[:, None, None, :, None] >= kv_pos[None, None, None, None, :]
-    scores = jnp.where(causal, scores, NEG_INF)
+    mask = q_positions[:, None, None, :, None] >= kv_pos[None, None, None, None, :]
+    if kv_lens is not None:
+        lens = jnp.asarray(kv_lens, jnp.int32)
+        mask = mask & (kv_pos[None, None, None, None, :] < lens[:, None, None, None, None])
+    scores = jnp.where(mask, scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
     out = jnp.einsum("bkgts,bskd->btkgd", probs, v)
-    return out.reshape(B, T, NH, D)
+    out = out.reshape(B, T, NH, D)
+    if kv_lens is not None:
+        out = jnp.where((lens > 0)[:, None, None, None], out, 0)
+    return out

@@ -366,6 +366,57 @@ def test_collective_bytes_async_start_equals_sync():
 
 
 # ---------------------------------------------------------------------------
+# green sweep: speculative verify programs (ISSUE 4)
+# ---------------------------------------------------------------------------
+def test_green_spec_verify_programs():
+    """The speculative serving programs (paged_verify per (bucket, K), next
+    to decode/prefill) verify clean under every pass: donated page buffers
+    aliased, zero host transfers, zero upcast-compute sites, zero
+    violations overall."""
+    from deepspeed_tpu.analysis import run_program_passes
+    from deepspeed_tpu.inference.scheduler import PagedServer
+    from deepspeed_tpu.inference.spec_decode import Drafter
+    from deepspeed_tpu.models import TransformerLM
+    from deepspeed_tpu.models.config import TransformerConfig
+
+    class TwoTokenDrafter(Drafter):
+        # always drafts something: every round is a verify dispatch
+        def propose(self, uid, context, k):
+            return np.asarray([0, 1][: max(k, 0)], np.int32)
+
+    cfg = TransformerConfig(
+        vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+        num_kv_heads=2, max_seq_len=64, norm="rmsnorm", position="rope",
+        activation="swiglu", use_bias=False, tie_embeddings=False,
+        flash_attention=False, dtype="float32",
+    )
+    model = TransformerLM(cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0, cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(0), toks)
+    tel = CompileTelemetry()
+    server = PagedServer(
+        cfg, params, page_size=8, max_slots=4, prefill_chunk=8,
+        attn_impl="xla", dtype=jnp.float32, telemetry=tel,
+        spec_decode={"max_draft": 2}, drafter=TwoTokenDrafter(),
+    )
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(0, 128, (7,)).astype(np.int32) for _ in range(3)]
+    server.serve(prompts, max_new_tokens=4)
+    assert server.stats["spec_rounds"] >= 1
+    rep = run_program_passes(tel)
+    names = set(rep["programs"])
+    assert any(n.startswith("paged_verify_") for n in names), names
+    t = rep["totals"]
+    assert t["analysis_failures"] == 0 and t["violations"] == 0, rep
+    assert t["donation_verified"] is True
+    for name in names:
+        passes = rep["programs"][name]["passes"]
+        assert passes["host_transfer"]["ok"]
+        assert passes["dtype_promotion"]["ok"]
+        assert passes["donation"]["ok"]
+
+
+# ---------------------------------------------------------------------------
 # jaxpr shape scan (the paged-attention structural guard's engine)
 # ---------------------------------------------------------------------------
 def test_find_aval_shapes_sees_through_control_flow():

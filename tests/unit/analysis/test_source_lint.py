@@ -130,6 +130,66 @@ def test_r004_missing_donation_on_buffer_args():
     assert len(r004) == 1  # only the undonated call site
 
 
+def test_r005_host_transfers_in_serving_loop_flagged():
+    """device_get / .item() / np.asarray-on-a-device-value inside a
+    *Server step method are each one synchronous tunnel RTT per round."""
+    rules = _rules("""
+        import numpy as np, jax
+        class PagedServer:
+            def _decode_step(self):
+                out = np.asarray(self.pending_tokens)
+                host = jax.device_get(self.lengths)
+                n = self.count.item()
+    """)
+    assert rules.count("DS-R005") == 3
+
+
+def test_r005_scoped_to_hot_loop_only():
+    """Intake methods, non-scheduler classes, and literal-built arrays are
+    host-side work, not device fetches — never flagged."""
+    assert "DS-R005" not in _rules("""
+        import numpy as np
+        class PagedServer:
+            def submit(self, prompt):
+                return np.asarray(prompt)  # intake, not the step loop
+            def _prefill_step(self):
+                starts = np.asarray([0, 1], np.int32)  # literal: host array
+        class PagePool:
+            def _decode_step(self):
+                return np.asarray(self.table)  # not a Server/Scheduler
+        class CurriculumScheduler:
+            def step(self, global_steps):
+                # host-only training-side scheduler: no serving round
+                # methods anywhere in the class, so step() is out of scope
+                return np.asarray(self.schedule[global_steps])
+    """)
+
+
+def test_r005_pragma_suppresses_and_is_error_severity():
+    findings = lint_source(textwrap.dedent("""
+        import numpy as np
+        class TokenScheduler:
+            def _verify_round(self):
+                a = np.asarray(self.out)
+                b = np.asarray(self.out)  # lint: allow(DS-R005)
+    """), path="deepspeed_tpu/foo.py")
+    r005 = [f for f in findings if f.rule == "DS-R005"]
+    assert len(r005) == 1  # the pragma'd line is suppressed
+    assert resolve_severity(r005[0]) == "error"
+
+
+def test_r005_warn_only_under_tests_prefix():
+    f = lint_source(
+        "import jax\n"
+        "class FooServer:\n"
+        "    def _decode_step(self):\n"
+        "        return jax.device_get(self.x)\n",
+        path="tests/unit/inference/fake.py",
+    )[0]
+    assert f.rule == "DS-R005"
+    assert resolve_severity(f) == "warn"
+
+
 def test_severity_tests_path_is_warn_only():
     f = lint_source("import jax.numpy as jnp\nx = jnp.repeat(k_cache, 2)\n", path="tests/unit/foo.py")[0]
     assert f.rule == "DS-R001"

@@ -132,6 +132,84 @@ def test_hbm_formula():
     assert pool.live_hbm_bytes() == 2 * 4 * per_token
 
 
+def test_rollback_frees_tail_pages():
+    """Speculation's rejected-tail contract: rollback shrinks the live
+    length and returns exactly the pages past the new length — LIFO, so
+    they are the next ones reallocated — without ever touching page 0."""
+    pool = _pool(num_pages=10, page_size=4)
+    slot = pool.alloc_slot(10)  # 3 pages
+    pool.advance(slot, 10)
+    free_before = pool.free_pages()
+    tail = int(pool.page_table[slot, 2])
+    freed = pool.rollback(slot, 5)  # 10 -> 5 tokens: 2 pages suffice
+    assert freed == 1
+    assert int(pool.seq_lens[slot]) == 5 and int(pool._owned[slot]) == 2
+    assert pool.free_pages() == free_before + 1
+    assert int(pool.page_table[slot, 2]) == -1
+    assert pool._free[-1] == tail and TRASH_PAGE not in pool._free
+    # rollback(0) trims pre-reserved pages past the live length, not tokens
+    assert pool.rollback(slot, 0) == 0
+    pool.ensure(slot, 12)
+    assert pool.rollback(slot, 0) == 1  # the speculative over-reserve
+    assert int(pool.seq_lens[slot]) == 5 and int(pool._owned[slot]) == 2
+
+
+def test_rollback_then_advance_roundtrip():
+    """advance after rollback must work once pages are re-ensured, and the
+    page-boundary case (rollback to an exact multiple) frees nothing."""
+    pool = _pool(num_pages=10, page_size=4)
+    slot = pool.alloc_slot(8)
+    pool.advance(slot, 8)
+    assert pool.rollback(slot, 4) == 1  # 8 -> 4: exactly one page back
+    assert pool.rollback(slot, 1) == 0  # 4 -> 3: same page still needed
+    assert pool.ensure(slot, 9)
+    pool.advance(slot, 6)
+    assert int(pool.seq_lens[slot]) == 9
+    # a full rollback empties the slot but keeps it allocated
+    assert pool.rollback(slot, 9) == 3
+    assert int(pool.seq_lens[slot]) == 0 and int(pool._owned[slot]) == 0
+    assert pool.free_pages() == 9
+    with pytest.raises(ValueError, match="rollback"):
+        pool.rollback(slot, 1)  # more tokens than the slot holds
+    with pytest.raises(ValueError, match="rollback"):
+        pool.rollback(slot, -1)
+
+
+def test_rollback_interacts_with_defrag():
+    """Pages freed by rollback become defrag holes; compaction must keep
+    every surviving token's bytes visible through the table."""
+    pool = _pool(num_pages=10, page_size=4)
+    s1 = pool.alloc_slot(8)
+    s2 = pool.alloc_slot(8)
+    pool.advance(s1, 8)
+    pool.advance(s2, 8)
+    k = pool.cache.k_pages
+    for s in (s1, s2):
+        for pid in pool.page_table[s]:
+            if pid >= 0:
+                k = k.at[:, int(pid)].set(float(pid))
+    pool.cache = pool.cache._replace(k_pages=k)
+    # roll s1 back to one page: its second page becomes a hole below s2
+    pool.rollback(s1, 4)
+    keep = {
+        (s, i): float(np.asarray(pool.cache.k_pages[0, int(pid), 0, 0, 0]))
+        for s in (s1, s2)
+        for i, pid in enumerate(pool.page_table[s]) if pid >= 0
+    }
+    pool.defrag()
+    live = sorted(
+        int(p) for s in (s1, s2) for p in pool.page_table[s] if p >= 0
+    )
+    assert live == [1, 2, 3]  # densest prefix after the trash page
+    after = {
+        (s, i): float(np.asarray(pool.cache.k_pages[0, int(pid), 0, 0, 0]))
+        for s in (s1, s2)
+        for i, pid in enumerate(pool.page_table[s]) if pid >= 0
+    }
+    assert after == keep
+    assert pool.free_pages() == 9 - 3
+
+
 def test_rows_returns_copies():
     pool = _pool()
     slot = pool.alloc_slot(4)

@@ -233,6 +233,24 @@ def test_prefill_chunk_one_and_results_drain(model_and_params):
         server.serve(prompts, max_new_tokens=[2])
 
 
+def test_prefill_pad_tail_never_aliases_live_pages():
+    """Regression: a final prompt chunk whose pad positions run past the
+    table width used to clamp onto the LAST live column and overwrite real
+    prompt k/v (positions 112..127 -> table slot 7 -> clamped to column 6 =
+    positions 96..111 here). Pad slots must write to the trash page."""
+    cfg = TransformerConfig(**{**CFG, "max_seq_len": 112})
+    model = TransformerLM(cfg)
+    rs = np.random.RandomState(15)
+    prompt = rs.randint(0, cfg.vocab_size, (104,)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(0), jnp.asarray(prompt[None, :8]))
+    server = PagedServer(
+        cfg, params, page_size=16, max_slots=2, prefill_chunk=32,
+        attn_impl="xla", dtype=jnp.float32,
+    )
+    out = server.serve([prompt], max_new_tokens=8)[0]
+    np.testing.assert_array_equal(out, _dense(cfg, params, prompt, 8))
+
+
 def test_serve_rejects_oversized_requests(model_and_params):
     cfg, _, params = model_and_params
     server = _server(cfg, params)

@@ -12,7 +12,10 @@
 # continuous-batching vs dense token-exactness + retrace/dispatch guards;
 # +static-analysis gate 2026-08-03: tools/lint.sh runs the repo AST lint —
 # errors in deepspeed_tpu/ fail the tier — and the analysis pass suite,
-# red fixtures + green sweep over the real step/serving programs).
+# red fixtures + green sweep over the real step/serving programs;
+# +13 speculative-decoding tests 2026-08-03: drafter units, spec-on vs
+# spec-off vs dense token-exactness incl. preemption/EOS/budget clamp,
+# one-dispatch-per-round + compile-bound guards, rollback accounting).
 cd "$(dirname "$0")/.." || exit 1
 sh tools/lint.sh || exit 1
 exec python -m pytest -q \
@@ -27,6 +30,7 @@ exec python -m pytest -q \
   tests/unit/runtime/zero \
   tests/unit/inference/test_kv_pool.py \
   tests/unit/inference/test_serving.py \
+  tests/unit/inference/test_spec_decode.py \
   tests/unit/ops/test_paged_attention.py \
   tests/unit/ops/test_op_builder.py \
   tests/unit/parallel/test_mesh.py \
