@@ -181,7 +181,7 @@ def _analysis_fields(engine):
     after the timed window (it re-traces + re-compiles each program once)."""
     try:
         rep = engine.analysis_report(
-            passes=["donation", "collectives", "host_transfer"]
+            passes=["donation", "collectives", "host_transfer", "overlap"]
         )
         t = rep["totals"]
         return {
@@ -189,6 +189,13 @@ def _analysis_fields(engine):
             "static_collective_bytes": int(t.get("collective_bytes", 0)),
             "donation_verified": bool(t.get("donation_verified", False)),
             "analysis_violations": int(t.get("violations", 0)),
+            # comm/compute overlap verifier (ISSUE 5): True only when no
+            # loop-body collective is exposed on the critical path; the byte
+            # split says how much of the schedule's collective traffic has
+            # real compute to hide behind vs how much is serialized.
+            "overlap_verified": t.get("overlap_verified"),
+            "hidden_collective_bytes": int(t.get("hidden_collective_bytes", 0)),
+            "exposed_collective_bytes": int(t.get("exposed_collective_bytes", 0)),
         }
     except Exception as e:
         # never fail a bench record over analysis, but never vanish
@@ -686,14 +693,17 @@ def _run_child(args, timeout_s, log_path):
 
 
 def _probe(budget_left):
-    """Probe the backend until it answers or the budget is nearly gone;
-    returns (platform|None, detail).
+    """Probe the backend; returns (platform|None, detail).
 
-    Retries are spread across the WHOLE budget, not front-loaded: the
-    round-4 capture hit an 18-minute tunnel outage inside a 22-minute
-    budget — a probe that gave up in the first 4 minutes missed the window
-    that opened later. Short per-attempt timeouts (75s) + a between-attempt
-    sleep keep each attempt killable while covering the full window.
+    The total probe budget is CAPPED (default 2 attempts ≈ 2.5 min
+    worst-case). Round 5 measured the old spread-across-the-budget policy
+    burning 13 × 75 s ≈ 16 min per down-tunnel run before the first stale
+    record was emitted — the whole round's budget spent learning the same
+    fact 13 times. One verdict serves the entire run: every config reuses
+    it (the per-config children never re-probe), and a down backend
+    completes the full bench — probe, stale re-emits, exit — in under five
+    minutes. DS_BENCH_PROBE_ATTEMPTS raises the cap when chasing a flaky
+    tunnel window is actually wanted.
 
     The result file, not the child's rc, is the success signal: a child that
     wrote it and then hung in backend teardown still counts."""
@@ -702,13 +712,12 @@ def _probe(budget_left):
     log = os.path.join(REPO, "bench_child_probe.log")
     out_path = os.path.join(REPO, ".bench_probe.json")
     detail = "no probe ran"
-    attempt = 0
-    fast_failures = 0
-    # Stop once even a warm-cache headline run could no longer fit
-    # (run_config skips configs below 75s left); stale/error emission after
-    # the loop needs only seconds.
-    while budget_left() > 90:
-        attempt += 1
+    max_attempts = max(1, int(os.environ.get("DS_BENCH_PROBE_ATTEMPTS", "2")))
+    for attempt in range(1, max_attempts + 1):
+        # stale/error emission after the loop needs only seconds; a verdict
+        # that would leave no room for even one warm config is still useful
+        if budget_left() <= 90:
+            break
         if os.path.exists(out_path):
             os.remove(out_path)
         timeout_s = min(75, max(20, budget_left() - 30))
@@ -724,15 +733,14 @@ def _probe(budget_left):
             + (f"timed out after {timeout_s:.0f}s" if timed_out else f"exited rc={rc}")
         )
         print(f"[bench] {detail}", file=sys.stderr, flush=True)
-        # A timeout means the tunnel is stalling — retrying across the whole
-        # budget catches a window that opens later. A FAST non-timeout exit
-        # is deterministic (import error, bad env): retrying forever would
-        # burn the budget in a tight spawn loop, so cap those.
-        if not timed_out:
-            fast_failures += 1
-            if fast_failures >= 3:
-                return None, detail + " (deterministic failure, giving up)"
-        time.sleep(min(20, max(2, budget_left() - 75)))
+        # a fast non-timeout exit is USUALLY deterministic (import error,
+        # bad env) but can be transient (tunnel proxy bouncing →
+        # connection-refused in seconds) — so it spends an attempt from the
+        # same cap instead of aborting the whole probe; fast failures cost
+        # seconds, so the <5-min down-backend guarantee is unaffected and
+        # DS_BENCH_PROBE_ATTEMPTS governs every failure mode
+        if attempt < max_attempts:
+            time.sleep(2 if not timed_out else min(20, max(2, budget_left() - 75)))
     return None, detail
 
 

@@ -27,6 +27,7 @@ from .passes import (  # noqa: F401
     find_aval_shapes,
     host_transfer_pass,
     iter_eqns,
+    overlap_pass,
 )
 from .report import (  # noqa: F401
     diff_trace_signatures,

@@ -65,19 +65,42 @@ def dtype_bytes(dtype: str) -> int:
     return 4  # unknown type: assume word-sized rather than dropping the op
 
 
-def shape_list_bytes(shape_str: str) -> int:
-    """Total bytes of every ``dtype[dims]`` shape inside ``shape_str``
-    (handles tuple shapes: ``(f32[2,4]{1,0}, f32[])``). Shapes in optimized
-    SPMD HLO are per-partition, so the result is bytes *per participating
-    device*."""
+def _shapes_bytes(shapes) -> int:
+    """Total bytes of ``(dtype, dims)`` pairs as matched by ``_SHAPE_RE`` —
+    the ONE copy of the byte-accounting math every extractor shares."""
     total = 0
-    for dtype, dims in _SHAPE_RE.findall(shape_str):
+    for dtype, dims in shapes:
         n = 1
         if dims:
             for d in dims.split(","):
                 n *= int(d)
         total += n * dtype_bytes(dtype)
     return total
+
+
+def shape_list_bytes(shape_str: str) -> int:
+    """Total bytes of every ``dtype[dims]`` shape inside ``shape_str``
+    (handles tuple shapes: ``(f32[2,4]{1,0}, f32[])``). Shapes in optimized
+    SPMD HLO are per-partition, so the result is bytes *per participating
+    device*."""
+    return _shapes_bytes(_SHAPE_RE.findall(shape_str))
+
+
+def async_start_result_bytes(shape_str: str) -> int:
+    """Bytes of the RESULT half of an async ``-start`` bundle shape
+    (``(operands..., results...)``) — the convention that keeps sync and
+    async lowerings of one collective reporting identical totals (operands
+    would otherwise double-count). Trailing ``u32[]``/``s32[]`` scalars are
+    scheduler context, not payload (collective-permute-start's
+    ``(src, dest, u32[], u32[])`` form) — counting them as the "result
+    half" would report ~8 bytes for an N-element permute. Falls back to
+    every payload shape when the bundle doesn't split evenly."""
+    shapes = _SHAPE_RE.findall(shape_str)
+    while shapes and shapes[-1][0] in ("u32", "s32") and not shapes[-1][1]:
+        shapes = shapes[:-1]
+    if len(shapes) >= 2 and len(shapes) % 2 == 0:
+        shapes = shapes[len(shapes) // 2 :]
+    return _shapes_bytes(shapes)
 
 
 def module_header(hlo_text: str) -> str:
@@ -136,20 +159,97 @@ def collect_collectives(hlo_text: str) -> Dict[str, Dict[str, Any]]:
         rec = out.setdefault(op, {"count": 0, "bytes": 0})
         rec["count"] += 1
         if suffix == "-start":
-            shapes = _SHAPE_RE.findall(shape_str)
-            if len(shapes) >= 2 and len(shapes) % 2 == 0:
-                shapes = shapes[len(shapes) // 2 :]  # results only
-            nbytes = 0
-            for dtype, dims in shapes:
-                n = 1
-                if dims:
-                    for d in dims.split(","):
-                        n *= int(d)
-                nbytes += n * dtype_bytes(dtype)
-            rec["bytes"] += nbytes
+            rec["bytes"] += async_start_result_bytes(shape_str)
         else:
             rec["bytes"] += shape_list_bytes(shape_str)
     return out
+
+
+class HloInstruction:
+    """One parsed op line of an HLO computation."""
+
+    __slots__ = ("name", "op", "suffix", "shape_str", "operands", "attrs", "index")
+
+    def __init__(self, name, op, suffix, shape_str, operands, attrs, index):
+        self.name = name
+        self.op = op  # base op name ("all-gather", "fusion", "dot", ...)
+        self.suffix = suffix  # "-start" | "-done" | ""
+        self.shape_str = shape_str
+        self.operands = operands  # %-referenced names (over-approximate)
+        self.attrs = attrs  # raw text after the operand list
+        self.index = index  # position in the computation (the schedule
+        # order: optimized modules carry is_scheduled=true)
+
+
+_COMP_HEADER_RE = re.compile(r"^(ENTRY\s+)?%([\w.$-]+)\s*\(.*\)\s*->.*\{\s*$")
+# the shape group must swallow tuple shapes nested two levels deep:
+# variadic async combiner starts (TPU AllGatherCombiner et al.) have
+# ``((operands...), (results...))`` bundle shapes — a flat ``\([^)]*\)``
+# stops at the first inner ')' and silently drops the instruction, which
+# would let an exposed loop collective go unseen by the overlap pass
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%([\w.$-]+)\s*=\s*"
+    r"((?:\((?:[^()]|\([^()]*\))*\))|[\w\[\]{},]+)\s+([\w-]+)\("
+)
+_REF_RE = re.compile(r"%([\w.$-]+)")
+_ASYNC_SUFFIX_RE = re.compile(r"^(.*?)(-start|-done)$")
+
+
+def parse_computations(hlo_text: str):
+    """{computation name: [HloInstruction]} for every computation in the
+    module, plus the entry computation's name. Operand lists are the
+    %-referenced names on the op line — an over-approximation (attribute
+    refs like ``calls=%fused_computation.2`` point at computations, which
+    never collide with same-computation instruction names, so they drop out
+    of the dependency maps)."""
+    comps: Dict[str, List[HloInstruction]] = {}
+    entry: Optional[str] = None
+    cur: Optional[str] = None
+    for line in hlo_text.splitlines():
+        h = _COMP_HEADER_RE.match(line)
+        if h:
+            cur = h.group(2)
+            comps[cur] = []
+            if h.group(1):
+                entry = cur
+            continue
+        if line.strip() == "}":
+            cur = None
+            continue
+        if cur is None:
+            continue
+        m = _INSTR_RE.match(line)
+        if not m:
+            continue
+        name, shape_str, opname = m.group(1), m.group(2), m.group(3)
+        suffix = ""
+        am = _ASYNC_SUFFIX_RE.match(opname)
+        if am and am.group(1) in COLLECTIVE_OPS:
+            opname, suffix = am.group(1), am.group(2)
+        rest = line[m.end() :]
+        operands = [r for r in _REF_RE.findall(rest) if r != name]
+        comps[cur].append(
+            HloInstruction(
+                name, opname, suffix, shape_str, operands, rest, len(comps[cur])
+            )
+        )
+    return comps, entry
+
+
+def while_body_computations(hlo_text: str) -> Set[str]:
+    """Names of computations executed as while-loop bodies (the lowered form
+    of ``lax.scan`` — where the training layer pipeline lives)."""
+    return set(re.findall(r"body=%([\w.$-]+)", hlo_text))
+
+
+def instruction_bytes(instr: "HloInstruction") -> int:
+    """Result payload bytes of one instruction. Async ``-start`` bundle
+    shapes carry ``(operands..., results...)`` — count the result half so
+    sync and async lowerings report identical totals (collect_collectives'
+    convention)."""
+    if instr.suffix == "-start":
+        return async_start_result_bytes(instr.shape_str)
+    return shape_list_bytes(instr.shape_str)
 
 
 def find_host_ops(hlo_text: str) -> List[Dict[str, str]]:

@@ -29,6 +29,7 @@ selection against one artifact.
 
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -498,11 +499,212 @@ def collectives_pass(
     return res
 
 
+# ---------------------------------------------------------------------------
+# comm/compute overlap verifier
+# ---------------------------------------------------------------------------
+_REAL_COMPUTE_OPS = {"dot", "convolution"}
+
+
+_CALLEE_REF_RE = re.compile(
+    r"(?:calls|to_apply|body|condition|true_computation|false_computation|"
+    r"branch_computations)=\{?%([\w.$-]+)"
+)
+_CALLEE_REF_LIST_RE = re.compile(
+    r"branch_computations=\{([^}]*)\}"
+)
+
+
+def _callee_refs(attrs: str) -> set:
+    refs = set(_CALLEE_REF_RE.findall(attrs))
+    for m in _CALLEE_REF_LIST_RE.finditer(attrs):
+        refs.update(re.findall(r"%([\w.$-]+)", m.group(1)))
+    return refs
+
+
+def _computation_callees(comps) -> Dict[str, set]:
+    """{computation: called-computation names} (fusion ``calls=``, while
+    bodies/conditions, conditional branches, ``to_apply=``) — the one
+    regex walk over every instruction's attrs, shared by transitive loop
+    membership and compute reachability so the two always agree."""
+    return {
+        cname: set().union(*[_callee_refs(i.attrs) for i in instrs])
+        if instrs
+        else set()
+        for cname, instrs in comps.items()
+    }
+
+
+def _computations_with_compute(comps, callees: Dict[str, set]) -> set:
+    """Computation names that (transitively, through ``callees``) contain a
+    dot/convolution — the "real compute" a collective can hide behind.
+    Elementwise fusions don't count: a schedule is only overlapped if there
+    is MXU-shaped work to run during the DMA."""
+    direct = {
+        cname
+        for cname, instrs in comps.items()
+        if any(i.op in _REAL_COMPUTE_OPS for i in instrs)
+    }
+    # fixpoint: a computation calling a compute-bearing one counts too
+    changed = True
+    has = set(direct)
+    while changed:
+        changed = False
+        for cname, refs in callees.items():
+            if cname not in has and refs & has:
+                has.add(cname)
+                changed = True
+    return has
+
+
+def _is_real_compute(instr, compute_comps: set) -> bool:
+    """dot/conv, or a fusion/conditional/while/call whose (transitive)
+    callee computations contain one — a cond-wrapped attention block or a
+    nested scan is schedulable work a collective can hide behind."""
+    if instr.op in _REAL_COMPUTE_OPS:
+        return True
+    if instr.op in ("fusion", "conditional", "while", "call"):
+        return bool(_callee_refs(instr.attrs) & compute_comps)
+    return False
+
+
+def _reach(start_names, succ) -> set:
+    seen = set(start_names)
+    frontier = list(start_names)
+    while frontier:
+        n = frontier.pop()
+        for nxt in succ.get(n, ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                frontier.append(nxt)
+    return seen
+
+
+def overlap_pass(art: ProgramArtifact, config: Optional[Dict[str, Any]] = None) -> PassResult:
+    """Static comm/compute-overlap verifier over the compiled schedule.
+
+    For every collective in the optimized module (the order is the schedule:
+    post-optimization HLO is ``is_scheduled=true``):
+
+    * async ``-start``/``-done`` pairs are **hidden** when real compute
+      (dot/conv, incl. inside fusions) sits between start and done in
+      schedule order without depending on the start — the latency-hiding
+      scheduler actually separated them;
+    * sync collectives (the CPU mesh, unscheduled backends) are **hidden**
+      when the computation contains real compute with no dependency path to
+      or from the collective — independent work the scheduler is free to
+      overlap (the feasibility the pipelined gather/bucketed reduce create).
+
+    ``overlap_verified`` means no collective inside a while-loop body (the
+    scanned layer stack / microbatch loop — the hot path the pipeline owns)
+    is exposed; entry-level tail collectives only count toward
+    ``exposed_bytes``. Exposed loop collectives are warn-severity findings
+    (error with ``require_overlap``)."""
+    cfg = config or {}
+    res = PassResult()
+    comps, _entry = hlo_parse.parse_computations(art.hlo_text)
+    bodies = hlo_parse.while_body_computations(art.hlo_text)
+    # loop membership is TRANSITIVE: a computation called from a while body
+    # (a cond branch, a to_apply/call target, a nested loop) executes once
+    # per iteration too — a collective there is just as serialized as one
+    # directly in the body, and missing it would false-green the verifier
+    callees = _computation_callees(comps)
+    loop_comps = set(bodies)
+    frontier = list(bodies)
+    while frontier:
+        c = frontier.pop()
+        for ref in callees.get(c, ()):
+            if ref not in loop_comps:
+                loop_comps.add(ref)
+                frontier.append(ref)
+    compute_comps = _computations_with_compute(comps, callees)
+
+    n_hidden = n_exposed = hidden_bytes = exposed_bytes = async_pairs = 0
+    loop_total = 0
+    loop_exposed: List[Dict[str, Any]] = []
+    for cname, instrs in comps.items():
+        colls = [
+            i for i in instrs if i.op in hlo_parse.COLLECTIVE_OPS and i.suffix != "-done"
+        ]
+        if not colls:
+            continue
+        defmap = {i.name: i for i in instrs}
+        succ: Dict[str, List[str]] = {i.name: [] for i in instrs}
+        pred: Dict[str, List[str]] = {i.name: [] for i in instrs}
+        for i in instrs:
+            for o in i.operands:
+                if o in defmap:
+                    succ[o].append(i.name)
+                    pred[i.name].append(o)
+        compute = [i for i in instrs if _is_real_compute(i, compute_comps)]
+        in_loop = cname in loop_comps
+        for c in colls:
+            nbytes = hlo_parse.instruction_bytes(c)
+            done = None
+            if c.suffix == "-start":
+                for j in instrs:
+                    if j.op == c.op and j.suffix == "-done" and c.name in j.operands:
+                        done = j
+                        break
+            if done is not None:
+                async_pairs += 1
+                desc = _reach([c.name], succ)
+                hidden = any(
+                    c.index < x.index < done.index and x.name not in desc
+                    for x in compute
+                )
+            else:
+                desc = _reach([c.name], succ)
+                anc = _reach([c.name], pred)
+                hidden = any(
+                    x.name not in desc and x.name not in anc for x in compute
+                )
+            if in_loop:
+                loop_total += 1
+            if hidden:
+                n_hidden += 1
+                hidden_bytes += nbytes
+            else:
+                n_exposed += 1
+                exposed_bytes += nbytes
+                if in_loop:
+                    loop_exposed.append(
+                        {"computation": cname, "op": c.op, "name": c.name, "bytes": nbytes}
+                    )
+
+    verified = not loop_exposed
+    res.summary = {
+        "collectives": n_hidden + n_exposed,
+        "hidden_count": n_hidden,
+        "exposed_count": n_exposed,
+        "hidden_bytes": hidden_bytes,
+        "exposed_bytes": exposed_bytes,
+        "async_pairs": async_pairs,
+        "loop_collectives": loop_total,
+        "loop_exposed": loop_exposed,
+        "overlap_verified": verified,
+    }
+    severity = "error" if cfg.get("require_overlap") else "warn"
+    for e in loop_exposed:
+        res.violations.append(
+            Violation(
+                "overlap",
+                art.name,
+                f"{e['op']} ({e['bytes']} bytes/device) in loop body "
+                f"{e['computation']} has no independent compute to hide "
+                "behind: the collective is exposed on the critical path",
+                severity=severity,
+                details=e,
+            )
+        )
+    return res
+
+
 PROGRAM_PASSES: Dict[str, Callable[[ProgramArtifact, Optional[Dict[str, Any]]], PassResult]] = {
     "donation": donation_pass,
     "dtype_promotion": dtype_promotion_pass,
     "host_transfer": host_transfer_pass,
     "collectives": collectives_pass,
+    "overlap": overlap_pass,
 }
 
 

@@ -87,6 +87,10 @@ def run_program_passes(
     # a report that never had donation in scope stays None throughout —
     # even its failure entries must not flip a flag nobody asked about
     donation_selected = passes is None or "donation" in passes
+    overlap_selected = passes is None or "overlap" in passes
+    overlap_ok = True
+    overlap_ran = False
+    hidden_bytes = exposed_bytes = 0
     coll_ops: Dict[str, Dict[str, int]] = {}
     coll_bytes = coll_count = 0
 
@@ -106,6 +110,9 @@ def run_program_passes(
             if donation_selected:
                 donation_ok = False  # requested but unanalyzable ≠ verified
                 donation_ran = True
+            if overlap_selected:
+                overlap_ok = False
+                overlap_ran = True
             report["programs"][name] = entry
             continue
         try:
@@ -116,6 +123,9 @@ def run_program_passes(
             if donation_selected:
                 donation_ok = False  # unanalyzable ≠ verified
                 donation_ran = True
+            if overlap_selected:
+                overlap_ok = False
+                overlap_ran = True
             report["programs"][name] = entry
             continue
         for pname, res in results.items():
@@ -129,6 +139,12 @@ def run_program_passes(
                 donation_ran = True
                 if not res.ok:
                     donation_ok = False
+            if pname == "overlap":
+                overlap_ran = True
+                if not res.summary.get("overlap_verified", False):
+                    overlap_ok = False
+                hidden_bytes += res.summary.get("hidden_bytes", 0)
+                exposed_bytes += res.summary.get("exposed_bytes", 0)
             if pname == "collectives":
                 for op, rec in res.summary.get("ops", {}).items():
                     agg = coll_ops.setdefault(op, {"count": 0, "bytes": 0})
@@ -146,6 +162,10 @@ def run_program_passes(
         # None (not True) when the donation pass never ran: a report built
         # from passes=["collectives"] must not read as donation-verified
         "donation_verified": donation_ok if donation_ran else None,
+        # same tri-state contract: None when the overlap pass never ran
+        "overlap_verified": overlap_ok if overlap_ran else None,
+        "hidden_collective_bytes": hidden_bytes,
+        "exposed_collective_bytes": exposed_bytes,
         "collective_count": coll_count,
         "collective_bytes": coll_bytes,
         "collectives": coll_ops,

@@ -24,6 +24,14 @@ Rules (each one traces back to a real incident in PERF.md / PR history):
   every fetch beyond the one budgeted token fetch per dispatch adds a
   synchronous tunnel RTT (~2 ms, PERF.md) to EVERY serving round. The
   sanctioned single fetch per dispatch carries a pragma.
+* **DS-R006 blocking-gather-in-scan-body** — a direct ``lax.all_gather`` /
+  ``lax.psum`` on parameter-named values inside a function used as a
+  ``lax.scan`` body: in the scanned layer stack those gathers belong to
+  the comm-overlap pipeline (``zero.prefetch_layers``,
+  ``runtime/zero/overlap.py``), which issues them a layer ahead of use —
+  a hand-rolled blocking collective at the use point serializes the loop
+  schedule the pipeline exists to overlap. Deliberate non-parameter or
+  non-pipelined collectives carry a pragma.
 
 Suppression: append ``# lint: allow(DS-RXXX)`` (or ``# noqa: DS-RXXX``) to
 the offending line. Findings in ``tests/`` are always downgraded to
@@ -44,8 +52,18 @@ RULES = {
     "DS-R003": "shape-dependent python branch inside a jitted function",
     "DS-R004": "jitted function with buffer-named args and no donate_argnums",
     "DS-R005": "host transfer inside the serving step loop (hot path)",
+    "DS-R006": "blocking collective on parameters inside a scanned layer body",
 }
 _WARN_ONLY = {"DS-R003", "DS-R004"}
+
+# DS-R006 operand scope: identifiers that look like model parameters — the
+# values whose scan-body gathers the overlap pipeline owns. Activation /
+# cotangent collectives (x, hidden, grads of activations) stay out of scope.
+_PARAMISH = re.compile(
+    r"(param|weight|^w$|^w\d+$|^w_|_w$|^wq$|^wk$|^wv$|^wo$|per_layer|layers?$)",
+    re.IGNORECASE,
+)
+_SCAN_COLLECTIVES = {"all_gather", "psum"}
 
 # DS-R005 scope: the per-round methods of a serving scheduler class — the
 # code that runs between every device dispatch while requests stream. A
@@ -324,6 +342,43 @@ def lint_source(src: str, path: str = "<string>") -> List[LintFinding]:
                         f"{fname} on a possible device value in {where} "
                         "(one fetch per dispatch is the budget)",
                     )
+
+    # ---- DS-R006: blocking param collectives in scan bodies -----------
+    scan_bodies: List[ast.AST] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = _dotted(node.func)
+        if not (fname == "scan" or fname.endswith(".scan")):
+            continue
+        if node.args:
+            body_arg = node.args[0]
+            if isinstance(body_arg, ast.Name):
+                scan_bodies.extend(fn_defs.get(body_arg.id, []))
+            elif isinstance(body_arg, ast.Lambda):
+                scan_bodies.append(body_arg)
+    seen_scan: Set[int] = set()
+    for body in scan_bodies:
+        if id(body) in seen_scan:
+            continue
+        seen_scan.add(id(body))
+        for n in ast.walk(body):
+            if not isinstance(n, ast.Call):
+                continue
+            fname = _dotted(n.func)
+            base = fname.rsplit(".", 1)[-1]
+            if base not in _SCAN_COLLECTIVES:
+                continue
+            operand_idents = _identifiers(n.args[0]) if n.args else set()
+            if any(_PARAMISH.search(i) for i in operand_idents):
+                add(
+                    n.lineno,
+                    "DS-R006",
+                    f"blocking {base} on parameter-like value "
+                    f"({', '.join(sorted(operand_idents)[:3])}) inside a "
+                    "lax.scan body: the comm-overlap pipeline "
+                    "(zero.prefetch_layers) should own this gather",
+                )
 
     # ---- DS-R004: jit call sites without donation ---------------------
     for call in collector.jit_calls:

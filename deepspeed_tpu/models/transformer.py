@@ -562,9 +562,26 @@ class TransformerLM(DSModule):
                     "layers exist (the first and last layers always run full)"
                 )
 
+        # comm-overlap plan (runtime/zero/overlap.py): set by the engine
+        # around its training-loss traces. reduce_grads pins each layer's
+        # cotangent to its scattered layout inside the backward scan
+        # (bucketed reduce-scatter); the prefetch pipeline below restructures
+        # the whole scan. Both are value-preserving, so every path stays
+        # bit-identical to the unpipelined program.
+        from deepspeed_tpu.runtime.zero.overlap import active_plan
+
+        overlap_plan = active_plan()
+
         def body(carry, scanned):
             x, rng = carry
             per_layer, layer_idx = scanned if pld_active else (scanned, None)
+            if overlap_plan is not None:
+                per_layer = overlap_plan.reduce_grads(per_layer)
+            if not pld_active:
+                x_new, rng, aux = self._scan_layer_step(
+                    x, per_layer, positions, rng, train
+                )
+                return (x_new, rng), aux
             if rng is not None:
                 rng, sub = jax.random.split(rng)
             else:
@@ -574,22 +591,19 @@ class TransformerLM(DSModule):
                 y, aux = self._layer(x_in, per_layer, positions, sub, train)
                 return self._activation_constraint(y), aux
 
-            if pld_active:
-                # PLD (reference runtime/progressive_layer_drop.py:40; Zhang &
-                # He 2020 stochastic depth): layer i bypassed with prob
-                # (i+1)/L * (1 - theta) — deeper layers dropped more; no
-                # rescale, identity passthrough, all layers active at eval.
-                # lax.cond skips the layer's compute at runtime.
-                sub, keep_rng = jax.random.split(sub)
-                keep_p = 1.0 - (layer_idx.astype(jnp.float32) + 1.0) / L * (
-                    1.0 - jnp.float32(pld_theta)
-                )
-                keep = jax.random.bernoulli(keep_rng, keep_p)
-                x_new, aux = jax.lax.cond(
-                    keep, run, lambda x_in: (x_in, jnp.zeros((), jnp.float32)), x
-                )
-            else:
-                x_new, aux = run(x)
+            # PLD (reference runtime/progressive_layer_drop.py:40; Zhang &
+            # He 2020 stochastic depth): layer i bypassed with prob
+            # (i+1)/L * (1 - theta) — deeper layers dropped more; no
+            # rescale, identity passthrough, all layers active at eval.
+            # lax.cond skips the layer's compute at runtime.
+            sub, keep_rng = jax.random.split(sub)
+            keep_p = 1.0 - (layer_idx.astype(jnp.float32) + 1.0) / L * (
+                1.0 - jnp.float32(pld_theta)
+            )
+            keep = jax.random.bernoulli(keep_rng, keep_p)
+            x_new, aux = jax.lax.cond(
+                keep, run, lambda x_in: (x_in, jnp.zeros((), jnp.float32)), x
+            )
             return (x_new, rng), aux
 
         def ltd_body(carry, scanned):
@@ -605,6 +619,8 @@ class TransformerLM(DSModule):
 
             x, rng = carry
             per_layer, idx = scanned  # idx [B, kept]
+            if overlap_plan is not None:
+                per_layer = overlap_plan.reduce_grads(per_layer)
             if rng is not None:
                 rng, sub = jax.random.split(rng)
             else:
@@ -649,6 +665,14 @@ class TransformerLM(DSModule):
                     )
                     aux_total = aux_total + aux
             x, base_rng, aux_total = run_full(x, base_rng, aux_total, 1 + n_ltd, L)
+        elif cfg.scan_layers and (
+            overlap_plan is not None
+            and overlap_plan.prefetch_enabled
+            and not pld_active
+        ):
+            x, aux_total = self._pipelined_layer_scan(
+                overlap_plan, params["layers"], x, base_rng, positions, train
+            )
         elif cfg.scan_layers:
             xs = (
                 (params["layers"], jnp.arange(L, dtype=jnp.int32))
@@ -673,6 +697,58 @@ class TransformerLM(DSModule):
             if cfg.lm_head_bias:
                 logits = logits + params["lm_head_bias"].astype(logits.dtype)
         return logits, aux_total
+
+    def _scan_layer_step(self, x, per_layer, positions, rng, train):
+        """One non-PLD scanned layer iteration: rng split, layer, activation
+        constraint. Shared by the plain scan body and the pipelined scan so
+        both trace the identical compute (and hence the pipeline stays
+        bit-identical to the unpipelined step at every depth)."""
+        if rng is not None:
+            rng, sub = jax.random.split(rng)
+        else:
+            sub = None
+        y, aux = self._layer(x, per_layer, positions, sub, train)
+        return self._activation_constraint(y), rng, aux
+
+    def _pipelined_layer_scan(self, plan, layers, x, base_rng, positions, train):
+        """Software-pipelined layer scan: layer *i+depth*'s ZeRO-3 all-gather
+        is issued while layer *i* computes, through a ``depth``-deep carry of
+        already-gathered per-layer params (prologue gathers layers
+        0..depth-1). Depth 0 is the explicit use-point gather — the same
+        gather/constraint ops issued at the layer's own iteration, no
+        lookahead carry — which is the "unpipelined step" the parity suite
+        compares against. Depth only moves where the gather is issued: the
+        gather is exact and the rng split order matches the plain scan body,
+        so every depth produces bit-identical outputs — only the schedule
+        changes. Tail iterations re-gather the last layer into
+        never-consumed buffers (index clamp); their cotangents are zero, so
+        gradients are untouched."""
+        cfg = self.config
+        L = cfg.num_layers
+        depth = max(0, min(int(plan.depth), L))
+
+        def pbody(carry, i):
+            x, rng, bufs = carry
+            if depth:
+                cur = plan.use_buffered(layers, bufs[0], i)
+                bufs = bufs[1:] + (
+                    plan.gather_layer(layers, jnp.minimum(i + depth, L - 1)),
+                )
+            else:
+                cur = plan.gather_layer(layers, i)
+            cur = plan.reduce_grads(plan.pin_gathered(cur))
+            y, rng, aux = self._scan_layer_step(x, cur, positions, rng, train)
+            return (y, rng, bufs), aux
+
+        if cfg.remat:
+            policy = getattr(jax.checkpoint_policies, cfg.remat_policy, None)
+            pbody = jax.checkpoint(pbody, policy=policy, prevent_cse=False)
+
+        bufs = tuple(plan.gather_layer(layers, min(j, L - 1)) for j in range(depth))
+        (x, _, _), aux_per_layer = jax.lax.scan(
+            pbody, (x, base_rng, bufs), jnp.arange(L, dtype=jnp.int32)
+        )
+        return x, jnp.sum(aux_per_layer)
 
     # --- layer streaming (ZeRO-Infinity param offload) -------------------
     def stream_fns(self):

@@ -143,7 +143,18 @@ class InstrumentedFunction:
             return fn(*args, **kwargs)
 
         traced.__name__ = getattr(fn, "__name__", stats.name)
-        self._jitted = jax.jit(traced, **jit_kwargs)
+        try:
+            self._jitted = jax.jit(traced, **jit_kwargs)
+        except TypeError:
+            # older jax: jit has no compiler_options (the engine passes XLA
+            # latency-hiding-scheduler flags through it when available) —
+            # run unscheduled rather than failing the program build
+            if "compiler_options" not in jit_kwargs:
+                raise
+            jit_kwargs = {
+                k: v for k, v in jit_kwargs.items() if k != "compiler_options"
+            }
+            self._jitted = jax.jit(traced, **jit_kwargs)
 
     def __call__(self, *args, **kwargs):
         st = self._stats

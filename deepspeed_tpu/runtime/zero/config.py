@@ -55,6 +55,15 @@ class DeepSpeedZeroConfig(DeepSpeedConfigModel):
         None, json_schema_extra={"deprecated": True, "new_param": "offload_optimizer"}
     )
 
+    # comm/compute overlap (runtime/zero/overlap.py): how many layers of the
+    # scanned stack the pipelined stage-3 gather runs AHEAD of use (the
+    # reference's prefetch coordinator depth). None → 1 when stage 3 and
+    # overlap_comm (the default there), off elsewhere; 0 = the explicit
+    # use-point gather (same gather structure, zero lookahead — the
+    # bit-identical "unpipelined" baseline of the parity suite). In-flight
+    # prefetched elements are additionally capped by
+    # stage3_prefetch_bucket_size.
+    prefetch_layers: Optional[int] = Field(None, ge=0)
     prefetch_bucket_size: int = Field(pp_int(int(5e7)), ge=0, alias="stage3_prefetch_bucket_size")
     param_persistence_threshold: int = Field(pp_int(int(1e5)), ge=0, alias="stage3_param_persistence_threshold")
     model_persistence_threshold: int = Field(pp_int(int(1e13)), ge=0, alias="stage3_model_persistence_threshold")

@@ -365,6 +365,105 @@ def test_collective_bytes_async_start_equals_sync():
     assert s["bytes"] == a["bytes"] == 64 * 256 * 4
 
 
+def test_parse_computations_variadic_combined_async_start():
+    """TPU's collective combiner emits variadic async starts whose bundle
+    shape nests tuples two deep: ``((operands...), (results...))``. The
+    instruction parser must not drop them — an unseen loop collective
+    would let the overlap pass report a false overlap_verified: True —
+    and the byte counter must count only the result half."""
+    from deepspeed_tpu.analysis.hlo import instruction_bytes, parse_computations
+
+    hlo = (
+        "ENTRY %main (p0: f32[2,4]) -> f32[8,4] {\n"
+        "  %p0 = f32[2,4]{1,0} parameter(0)\n"
+        "  %ags = ((f32[2,4]{1,0}, f32[2,4]{1,0}), (f32[8,4]{1,0}, f32[8,4]{1,0}))"
+        " all-gather-start(f32[2,4]{1,0} %p0, f32[2,4]{1,0} %p0), dimensions={0}\n"
+        "  ROOT %agd = (f32[8,4]{1,0}, f32[8,4]{1,0}) all-gather-done(%ags)\n"
+        "}\n"
+    )
+    comps, entry = parse_computations(hlo)
+    ops = {i.name: i for i in comps[entry]}
+    assert "ags" in ops, "variadic combined async start dropped by the parser"
+    start = ops["ags"]
+    assert start.op == "all-gather" and start.suffix == "-start"
+    assert instruction_bytes(start) == 2 * 8 * 4 * 4  # results only
+
+
+def test_async_start_context_scalars_not_counted_as_results():
+    """collective-permute-start's bundle is ``(src, dest, u32[], u32[])`` —
+    the trailing u32[] scalars are scheduler context, not payload. The
+    even-split heuristic must not take them as the "result half" (that
+    would report ~8 bytes for an N-element permute)."""
+    from deepspeed_tpu.analysis.hlo import instruction_bytes, parse_computations
+
+    hlo = (
+        "ENTRY %main (p0: f32[64,32]) -> f32[64,32] {\n"
+        "  %p0 = f32[64,32]{1,0} parameter(0)\n"
+        "  %cps = (f32[64,32]{1,0}, f32[64,32]{1,0}, u32[], u32[])"
+        " collective-permute-start(f32[64,32]{1,0} %p0),"
+        " source_target_pairs={{0,1},{1,0}}\n"
+        "  ROOT %cpd = f32[64,32]{1,0} collective-permute-done(%cps)\n"
+        "}\n"
+    )
+    comps, entry = parse_computations(hlo)
+    start = {i.name: i for i in comps[entry]}["cps"]
+    assert start.op == "collective-permute" and start.suffix == "-start"
+    assert instruction_bytes(start) == 64 * 32 * 4  # the dest payload only
+
+
+def test_overlap_loop_membership_is_transitive():
+    """An exposed collective in a computation *called from* a while body
+    (here via ``call``/``to_apply`` — same shape as a cond branch or a
+    nested scan) executes once per iteration, exactly like one written
+    directly in the body. The overlap pass must treat it as a loop
+    collective: if membership stopped at the body itself, this schedule
+    would false-green as overlap_verified."""
+    from deepspeed_tpu.analysis.passes import ProgramArtifact, overlap_pass
+
+    hlo = (
+        "%gather_and_dot (p: f32[8,64]) -> f32[64,64] {\n"
+        "  %p = f32[8,64]{1,0} parameter(0)\n"
+        "  %ag = f32[64,64]{1,0} all-gather(f32[8,64]{1,0} %p), dimensions={0}\n"
+        "  ROOT %d = f32[64,64]{1,0} dot(f32[64,64]{1,0} %ag, f32[64,64]{1,0}"
+        " %ag), lhs_contracting_dims={1}, rhs_contracting_dims={0}\n"
+        "}\n"
+        "%body (t: (s32[], f32[8,64])) -> (s32[], f32[8,64]) {\n"
+        "  %t = (s32[], f32[8,64]{1,0}) parameter(0)\n"
+        "  %i = s32[] get-tuple-element((s32[], f32[8,64]{1,0}) %t), index=0\n"
+        "  %w = f32[8,64]{1,0} get-tuple-element((s32[], f32[8,64]{1,0}) %t), index=1\n"
+        "  %c = f32[64,64]{1,0} call(f32[8,64]{1,0} %w), to_apply=%gather_and_dot\n"
+        "  %sl = f32[8,64]{1,0} slice(f32[64,64]{1,0} %c), slice={[0:8], [0:64]}\n"
+        "  %one = s32[] constant(1)\n"
+        "  %ip = s32[] add(s32[] %i, s32[] %one)\n"
+        "  ROOT %r = (s32[], f32[8,64]{1,0}) tuple(s32[] %ip, f32[8,64]{1,0} %sl)\n"
+        "}\n"
+        "%cond (t: (s32[], f32[8,64])) -> pred[] {\n"
+        "  %t = (s32[], f32[8,64]{1,0}) parameter(0)\n"
+        "  %i = s32[] get-tuple-element((s32[], f32[8,64]{1,0}) %t), index=0\n"
+        "  %n = s32[] constant(4)\n"
+        "  ROOT %lt = pred[] compare(s32[] %i, s32[] %n), direction=LT\n"
+        "}\n"
+        "ENTRY %main (p0: f32[8,64]) -> (s32[], f32[8,64]) {\n"
+        "  %p0 = f32[8,64]{1,0} parameter(0)\n"
+        "  %zero = s32[] constant(0)\n"
+        "  %init = (s32[], f32[8,64]{1,0}) tuple(s32[] %zero, f32[8,64]{1,0} %p0)\n"
+        "  ROOT %wh = (s32[], f32[8,64]{1,0}) while((s32[], f32[8,64]{1,0})"
+        " %init), condition=%cond, body=%body\n"
+        "}\n"
+    )
+    art = ProgramArtifact("fixture", wrapper=None)
+    art._hlo_text = hlo
+    res = overlap_pass(art)
+    # the gather feeds the only dot, so nothing independent hides it...
+    assert res.summary["exposed_count"] == 1, res.summary
+    # ...and it sits one call level below the while body: still a loop
+    # collective, so the program must NOT verify
+    assert res.summary["loop_collectives"] == 1, res.summary
+    assert res.summary["overlap_verified"] is False, res.summary
+    assert res.violations
+    assert res.violations[0].details["computation"] == "gather_and_dot"
+
+
 # ---------------------------------------------------------------------------
 # green sweep: speculative verify programs (ISSUE 4)
 # ---------------------------------------------------------------------------

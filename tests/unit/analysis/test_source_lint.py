@@ -190,6 +190,60 @@ def test_r005_warn_only_under_tests_prefix():
     assert resolve_severity(f) == "warn"
 
 
+def test_r006_blocking_gather_in_scan_body_flagged():
+    """A hand-rolled param all-gather inside a lax.scan body is the gather
+    the overlap pipeline (zero.prefetch_layers) should own."""
+    assert "DS-R006" in _rules("""
+        import jax
+        def body(carry, per_layer):
+            gathered = jax.lax.all_gather(per_layer, "data")
+            return carry, gathered
+        def stack(x, layers):
+            return jax.lax.scan(body, x, layers)
+    """)
+
+
+def test_r006_psum_on_weights_flagged_and_activations_ok():
+    src_w = """
+        import jax
+        def body(c, w_layer):
+            full = jax.lax.psum(w_layer, "data")
+            return c, full
+        def run(x, ws):
+            return jax.lax.scan(body, x, ws)
+    """
+    assert "DS-R006" in _rules(src_w)
+    # activation collectives (sequence-parallel reductions on x / hidden)
+    # are not the pipeline's gathers — out of scope
+    assert "DS-R006" not in _rules("""
+        import jax
+        def body(c, x_chunk):
+            h = jax.lax.psum(x_chunk, "sequence")
+            return c, h
+        def run(x, xs):
+            return jax.lax.scan(body, x, xs)
+    """)
+
+
+def test_r006_outside_scan_body_not_flagged():
+    assert "DS-R006" not in _rules("""
+        import jax
+        def gather(per_layer):
+            return jax.lax.all_gather(per_layer, "data")
+    """)
+
+
+def test_r006_pragma_suppresses():
+    assert "DS-R006" not in _rules("""
+        import jax
+        def body(carry, per_layer):
+            g = jax.lax.all_gather(per_layer, "data")  # lint: allow(DS-R006)
+            return carry, g
+        def stack(x, layers):
+            return jax.lax.scan(body, x, layers)
+    """)
+
+
 def test_severity_tests_path_is_warn_only():
     f = lint_source("import jax.numpy as jnp\nx = jnp.repeat(k_cache, 2)\n", path="tests/unit/foo.py")[0]
     assert f.rule == "DS-R001"

@@ -10,7 +10,12 @@ import numpy as np
 import pytest
 
 import deepspeed_tpu as ds
-from tests.unit.simple_model import SimpleModel, random_dataloader
+from tests.unit.simple_model import (
+    SimpleModel,
+    learnable_dataloader,
+    random_dataloader,
+    rel_loss_decrease,
+)
 
 
 def _train(stage, steps=5, gas=1, dtype="bf16", hidden=64):
@@ -29,7 +34,10 @@ def _train(stage, steps=5, gas=1, dtype="bf16", hidden=64):
         config.pop(dtype)
     engine, *_ = ds.initialize(model=SimpleModel(hidden), config=config)
     losses = []
-    for i, batch in enumerate(random_dataloader(hidden, total_samples=steps * gas * 8, batch_size=8)):
+    # deterministic fixed-batch data with a guaranteed gradient (same
+    # de-flake as test_zeropp): learning is a property of the optimizer,
+    # not of the per-step random targets the old loader drew
+    for i, batch in enumerate(learnable_dataloader(hidden, total_samples=steps * gas * 8, batch_size=8)):
         loss = engine(batch)
         engine.backward(loss)
         engine.step()
@@ -40,7 +48,7 @@ def _train(stage, steps=5, gas=1, dtype="bf16", hidden=64):
 @pytest.mark.parametrize("stage", [0, 1, 2, 3])
 def test_zero_stage_trains(stage, eight_devices):
     engine, losses = _train(stage)
-    assert losses[-1] < losses[0], f"stage {stage} did not learn: {losses}"
+    assert rel_loss_decrease(losses) > 0.05, f"stage {stage} did not learn: {losses}"
 
 
 def test_zero_stages_identical_math(eight_devices):

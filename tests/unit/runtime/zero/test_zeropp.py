@@ -13,7 +13,12 @@ import pytest
 
 import deepspeed_tpu as ds
 import deepspeed_tpu.parallel.mesh as mesh_mod
-from tests.unit.simple_model import SimpleModel, random_dataloader
+from tests.unit.simple_model import (
+    SimpleModel,
+    learnable_dataloader,
+    random_dataloader,
+    rel_loss_decrease,
+)
 
 HIDDEN = 64
 
@@ -32,7 +37,10 @@ def _train(zero_cfg, steps=5, bf16=False):
         config["bf16"] = {"enabled": True}
     engine, *_ = ds.initialize(model=SimpleModel(HIDDEN), config=config)
     losses = []
-    for batch in random_dataloader(HIDDEN, total_samples=steps * 8, batch_size=8):
+    # deterministic fixed-batch data with a guaranteed gradient: "did the
+    # run learn" is then a property of the optimizer, not of the rng draw
+    # (the old per-step random targets flaked under the box's jax 0.4.37)
+    for batch in learnable_dataloader(HIDDEN, total_samples=steps * 8, batch_size=8):
         loss = engine(batch)
         engine.backward(loss)
         engine.step()
@@ -50,7 +58,7 @@ class TestQwZ:
                 "zero_quantized_weights": True,
             }
         )
-        assert quant[-1] < quant[0], "qwZ run did not learn"
+        assert rel_loss_decrease(quant) > 0.05, f"qwZ run did not learn: {quant}"
         np.testing.assert_allclose(quant, exact, rtol=0.05, atol=5e-3)
         # int8 quantization must actually perturb the math (i.e. the flag is
         # consumed, not ignored)
@@ -99,7 +107,7 @@ class TestQgZ:
             }
         )
         assert engine._fused_step_enabled is False  # explicit grad path in use
-        assert quant[-1] < quant[0], "qgZ run did not learn"
+        assert rel_loss_decrease(quant) > 0.05, f"qgZ run did not learn: {quant}"
         np.testing.assert_allclose(quant, exact, rtol=0.05, atol=5e-3)
         assert not np.allclose(quant, exact, rtol=1e-12, atol=0)
         # grad norms must agree in scale (catches missing 1/world averaging)

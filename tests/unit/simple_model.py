@@ -39,6 +39,29 @@ def random_dataloader(model_dim: int = 16, total_samples: int = 64, batch_size: 
         yield (x[i : i + batch_size], y[i : i + batch_size])
 
 
+def learnable_dataloader(model_dim: int = 16, total_samples: int = 64, batch_size: int = 8, seed: int = 0):
+    """Deterministic regression stream with a GUARANTEED loss gradient:
+    every step yields the same (x, y) batch, with y a fixed contraction of
+    x — a target the MLP can move toward from its small-init state. A
+    working optimizer therefore decreases the loss on every early step;
+    "did the run learn" becomes a property of the optimizer, not of which
+    random targets the step happened to draw (random_dataloader's fresh
+    noise per step made 5-step loss-decrease asserts flake under jax-rng
+    changes: the "did not learn in 5 steps" class in fast_tests.sh)."""
+    rs = np.random.RandomState(seed)
+    x = rs.randn(batch_size, model_dim).astype(np.float32)
+    y = (0.5 * x).astype(np.float32)
+    for _ in range(0, total_samples, batch_size):
+        yield (x, y)
+
+
+def rel_loss_decrease(losses) -> float:
+    """Relative loss decrease over a run — the de-flaked learning criterion
+    (scale-free, so it holds across dtypes and quantized variants)."""
+    first = float(losses[0])
+    return (first - float(losses[-1])) / max(abs(first), 1e-12)
+
+
 def sequence_dataloader(vocab: int = 128, seq: int = 32, total: int = 32, batch: int = 8, seed: int = 0):
     rs = np.random.RandomState(seed)
     toks = rs.randint(0, vocab, (total, seq + 1)).astype(np.int32)
