@@ -549,6 +549,12 @@ def bench_decode_serving():
 
     timed_serve()  # compile every bucket/chunk program
     paged_tps = timed_serve()
+    # serving SLOs + prefix-cache effectiveness of the measured (spec-off)
+    # server: p50/p99 TTFT (submit -> first token, queue wait included) and
+    # TPOT from serve_stats(), plus the pool's prefix hit rate — the warm
+    # pass re-serves the same prompts, so shared full pages attach instead
+    # of re-prefilling (the production shared-system-prompt pattern)
+    base_stats = engine.serve_stats()
     # speculation ON through the same engine/telemetry: the server is
     # rebuilt from the flipped knob, verify programs compile once, and the
     # second pass is the measured one
@@ -582,11 +588,25 @@ def bench_decode_serving():
 
     timed_dense()  # compile
     dense_tps = timed_dense()
+    ttft = base_stats.get("ttft_ms", {})
+    tpot = base_stats.get("tpot_ms", {})
+    prefix = base_stats.get("prefix", {})
     rec = {
         "metric": METRICS["decode_serving"],
         "value": round(paged_tps, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(paged_tps / dense_tps, 4),
+        # serving SLO percentiles (TTFT includes queue wait; the headline
+        # for serving is latency distribution, not aggregate tokens/s —
+        # arXiv 2605.25645's TTFT/TPOT framing)
+        "ttft_p50_ms": round(ttft.get("p50", 0.0), 2),
+        "ttft_p99_ms": round(ttft.get("p99", 0.0), 2),
+        "tpot_p50_ms": round(tpot.get("p50", 0.0), 3),
+        "tpot_p99_ms": round(tpot.get("p99", 0.0), 3),
+        # prefix caching: fraction of looked-up prompt tokens attached from
+        # the page index instead of re-prefilled, + CoW divergence copies
+        "prefix_hit_rate": round(prefix.get("prefix_hit_rate", 0.0), 4),
+        "prefix_cow_copies": int(prefix.get("cow_copies", 0)),
         # speculative serving: same metric with n-gram draft-and-verify on
         "spec_on_value": round(spec_tps, 1),
         "spec_vs_off": round(spec_tps / paged_tps, 4),

@@ -32,6 +32,16 @@ Rules (each one traces back to a real incident in PERF.md / PR history):
   a hand-rolled blocking collective at the use point serializes the loop
   schedule the pipeline exists to overlap. Deliberate non-parameter or
   non-pipelined collectives carry a pragma.
+* **DS-R007 pool-internals-mutated-outside-pool** — writing ``PagePool``
+  internals (page tables, seq lens, free lists, refcounts, the prefix
+  index, or the device cache) from outside the pool's own methods: the
+  prefix-sharing pool holds CoW/refcount invariants (an indexed page is
+  immutable; a shared page is never written; free ∪ cached ∪ referenced
+  exactly partitions the pool) that only its methods preserve — a direct
+  ``pool.page_table[...] = x`` or ``pool._free.append(p)`` corrupts KV
+  silently. Go through ``alloc_slot`` / ``prepare_write`` / ``advance`` /
+  ``rollback`` / ``free_slot`` / ``set_cache``; deliberate surgery (tests,
+  checkpoint restore) carries a pragma.
 
 Suppression: append ``# lint: allow(DS-RXXX)`` (or ``# noqa: DS-RXXX``) to
 the offending line. Findings in ``tests/`` are always downgraded to
@@ -53,8 +63,27 @@ RULES = {
     "DS-R004": "jitted function with buffer-named args and no donate_argnums",
     "DS-R005": "host transfer inside the serving step loop (hot path)",
     "DS-R006": "blocking collective on parameters inside a scanned layer body",
+    "DS-R007": "PagePool internals mutated outside the pool's own methods",
 }
 _WARN_ONLY = {"DS-R003", "DS-R004"}
+
+# DS-R007 scope: the pool state only pool methods may write. Distinctive
+# names flag on ANY receiver; the generic ones (cache/_free/_owned/seq_lens
+# collide with unrelated classes) only on a pool-ish receiver.
+_POOL_ATTRS = {
+    "page_table", "seq_lens", "cache", "_free", "_free_slots", "_owned",
+    "_refcount", "_hash_index", "_page_hash", "_cached", "_chain_keys",
+}
+_POOL_DISTINCT = {
+    "page_table", "_free_slots", "_refcount", "_hash_index", "_page_hash",
+    "_chain_keys",
+}
+_POOLISH = re.compile(r"pool", re.IGNORECASE)
+_POOL_CLASS = re.compile(r"Pool$")
+_MUTATORS = {
+    "append", "appendleft", "pop", "popleft", "popitem", "extend", "remove",
+    "insert", "clear", "update", "setdefault", "sort", "reverse", "fill",
+}
 
 # DS-R006 operand scope: identifiers that look like model parameters — the
 # values whose scan-body gathers the overlap pipeline owns. Activation /
@@ -379,6 +408,54 @@ def lint_source(src: str, path: str = "<string>") -> List[LintFinding]:
                     "lax.scan body: the comm-overlap pipeline "
                     "(zero.prefetch_layers) should own this gather",
                 )
+
+    # ---- DS-R007: pool internals mutated outside the pool -------------
+    def _pool_attr(node):
+        """(attr, receiver) when ``node`` is ``<recv>.<protected attr>``
+        (possibly through a subscript), else None."""
+        if isinstance(node, ast.Subscript):
+            node = node.value
+        if isinstance(node, ast.Attribute) and node.attr in _POOL_ATTRS:
+            return node.attr, _dotted(node.value)
+        return None
+
+    def _flag_r007(node, attr, recv, how):
+        if attr in _POOL_DISTINCT or _POOLISH.search(recv or ""):
+            add(
+                node.lineno,
+                "DS-R007",
+                f"{how} of PagePool internal {recv or '<expr>'}.{attr} outside "
+                "the pool's methods breaks the CoW/refcount invariants (use "
+                "alloc_slot/prepare_write/advance/rollback/free_slot/set_cache)",
+            )
+
+    def _scan_r007(node, in_pool):
+        if isinstance(node, ast.ClassDef) and _POOL_CLASS.search(node.name):
+            in_pool = True  # the pool's own methods are the sanctioned writers
+        if not in_pool:
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.Delete)):
+                targets = (
+                    [node.target] if isinstance(node, ast.AugAssign)
+                    else node.targets
+                )
+                flat = []
+                for t in targets:
+                    flat.extend(
+                        t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+                    )
+                for t in flat:
+                    hit = _pool_attr(t)
+                    if hit:
+                        _flag_r007(node, hit[0], hit[1], "write")
+            elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+                if node.func.attr in _MUTATORS:
+                    hit = _pool_attr(node.func.value)
+                    if hit:
+                        _flag_r007(node, hit[0], hit[1], f".{node.func.attr}()")
+        for child in ast.iter_child_nodes(node):
+            _scan_r007(child, in_pool)
+
+    _scan_r007(tree, False)
 
     # ---- DS-R004: jit call sites without donation ---------------------
     for call in collector.jit_calls:

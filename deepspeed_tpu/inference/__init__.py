@@ -5,5 +5,15 @@ from deepspeed_tpu.inference.kv_pool import (  # noqa: F401
     PagePool,
     init_paged_cache,
 )
-from deepspeed_tpu.inference.scheduler import PagedServer, Request  # noqa: F401
+from deepspeed_tpu.inference.scheduler import (  # noqa: F401
+    PagedServer,
+    Request,
+    SchedulingPolicy,
+    YoungestFirstPolicy,
+)
 from deepspeed_tpu.inference.spec_decode import Drafter, NGramDrafter  # noqa: F401
+from deepspeed_tpu.inference.traffic import (  # noqa: F401
+    MultiTenantServer,
+    SLAPolicy,
+    TenantSpec,
+)

@@ -3,7 +3,7 @@
 from __future__ import annotations
 
 from enum import Enum
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 from pydantic import Field, model_validator
 
@@ -76,6 +76,13 @@ class PagedKVConfig(DeepSpeedConfigModel):
     per bucket, one prefill program per chunk size — plus
     ``len(slot_buckets) × len(spec_lens)`` verify programs when
     ``spec_decode.enable`` is set.
+
+    ``prefix_cache`` turns on page-level prefix sharing: full KV pages are
+    indexed by a content chain hash, requests attach the longest cached
+    prefix of their context by reference (refcounted, copy-on-write on
+    divergence), and N requests sharing a system prompt pay its prefill
+    and HBM once. Greedy streams stay byte-identical to sharing-off
+    serving; sharing adds zero programs and zero dispatches.
     """
 
     enabled: bool = True
@@ -86,6 +93,36 @@ class PagedKVConfig(DeepSpeedConfigModel):
     max_seq_len: int = 0  # 0 = the model config's max_seq_len
     prefill_chunk: int = 32  # prompt tokens per interleaved prefill dispatch
     attn_impl: str = "auto"  # auto | pallas | xla (decode attention backend)
+    prefix_cache: bool = True  # page-level prefix sharing (hash-of-block + CoW)
+
+
+class TenantConfig(DeepSpeedConfigModel):
+    """One tenant's serving contract (``inference/traffic.py:TenantSpec``):
+    token-budget ``weight`` (fair share of served tokens), strict
+    ``priority`` class (admitted first, preempted last), TTFT/TPOT SLA
+    targets (reported as attainment, not enforced), and admission-control
+    caps (``max_queued`` submissions rejected beyond the queue depth;
+    ``max_live_slots`` bounds concurrent slots)."""
+
+    name: str
+    weight: float = 1.0
+    priority: int = 0
+    ttft_target_ms: Optional[float] = None
+    tpot_target_ms: Optional[float] = None
+    max_queued: Optional[int] = None
+    max_live_slots: Optional[int] = None
+
+
+class TrafficConfig(DeepSpeedConfigModel):
+    """Multi-tenant SLA serving knobs. With ``enabled`` the engine wraps
+    its ``PagedServer`` in a ``MultiTenantServer``: weighted-deficit +
+    priority scheduling over the per-tenant contracts in ``tenants``,
+    per-tenant breakdowns in ``serve_stats()``, and queue-cap admission
+    control at ``submit``. Unknown tenants fall back to a weight-1
+    priority-0 default."""
+
+    enabled: bool = False
+    tenants: List[TenantConfig] = Field(default_factory=list)
 
 
 class SpecDecodeConfig(DeepSpeedConfigModel):
@@ -120,6 +157,7 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     quant: QuantizationConfig = Field(default_factory=QuantizationConfig)
     paged_kv: PagedKVConfig = Field(default_factory=PagedKVConfig)
     spec_decode: SpecDecodeConfig = Field(default_factory=SpecDecodeConfig)
+    traffic: TrafficConfig = Field(default_factory=TrafficConfig)
     analysis: AnalysisConfig = Field(default_factory=AnalysisConfig)
     checkpoint: Optional[Any] = None
     base_dir: str = ""

@@ -537,7 +537,7 @@ class InferenceEngine:
         pcfg = self._config.paged_kv
         if not pcfg.enabled:
             raise ValueError("paged serving is disabled (inference config paged_kv.enabled)")
-        return PagedServer(
+        server = PagedServer(
             self._ds_config,
             self._params,
             page_size=pcfg.page_size,
@@ -550,7 +550,19 @@ class InferenceEngine:
             dtype=self.dtype,
             telemetry=self._telemetry,
             spec_decode=self._config.spec_decode,
+            prefix_cache=pcfg.prefix_cache,
         )
+        tcfg = self._config.traffic
+        if tcfg.enabled:
+            # multi-tenant SLA layer (inference/traffic.py): weighted-deficit
+            # + priority scheduling, queue-cap admission control, per-tenant
+            # serve_stats() breakdowns — same serve()/submit()/step surface
+            from deepspeed_tpu.inference.traffic import MultiTenantServer
+
+            server = MultiTenantServer(
+                server, tenants=[t.model_dump() for t in tcfg.tenants]
+            )
+        return server
 
     def serve(self, prompts, max_new_tokens=32, eos_token_id=None):
         """Continuous-batching greedy generation over the paged KV pool:
@@ -575,7 +587,11 @@ class InferenceEngine:
         (admitted, preempted, finished, prefill_chunks, decode_steps,
         spec_rounds), speculation quality (``spec_accept_rate``,
         ``spec_mean_accepted_per_round``, the ``spec_accept_hist`` draft-hit
-        histogram), and pool occupancy/utilization."""
+        histogram), pool occupancy/utilization, prefix-cache counters
+        (``prefix`` — hit rate, CoW copies, cached pages), latency SLOs
+        (``ttft_ms`` / ``tpot_ms`` p50/p99), and per-tenant breakdowns
+        (``tenants`` — plus budget/goodput shares and SLA attainment when
+        ``inference.traffic`` is enabled)."""
         if self._paged_server is None:
             return {}
         return self._paged_server.serve_stats()

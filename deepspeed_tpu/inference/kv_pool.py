@@ -1,4 +1,4 @@
-"""Block-pool KV cache for paged serving.
+"""Block-pool KV cache for paged serving, with page-level prefix sharing.
 
 The dense decode workspace (``inference/decode.py:init_cache``) allocates
 ``[L, B, max_len, NKV, D]`` per batch — HBM scales with ``batch × max_len``
@@ -18,16 +18,40 @@ Split of responsibilities:
   updates alias in place.
 * ``PagePool`` — the host-side allocator: free list, per-slot page tables
   and live lengths (numpy; they ride into each dispatch as plain int32
-  arrays, so allocation changes never retrace a program), alloc/free/defrag.
+  arrays, so allocation changes never retrace a program), alloc/free/defrag,
+  and the **prefix index**.
 
-Page 0 is the reserved TRASH page: it is never allocated, table sentinels
-(-1) clamp onto it inside the kernels, and dead-slot writes land there — a
-padded batch row can never corrupt a live sequence's pages.
+Prefix sharing (production traffic: N requests carrying the same system
+prompt must pay its prefill and HBM once):
+
+* every FULL page a sequence writes can be *registered* under a
+  **chain hash** — ``hash(previous block's chain key, this block's token
+  content)`` — so a key identifies a whole prefix, not just a block;
+* a new request *matches* its prompt against the index block-by-block and
+  **attaches** the longest indexed prefix: the shared pages enter its page
+  table, the per-page **refcount** rises, and prefill resumes after them;
+* pages reachable from the index are **immutable**. The write barrier
+  (``prepare_write``) enforces it: a shared page (refcount > 1) in the
+  about-to-be-written span is replaced by a private **copy-on-write**
+  duplicate (divergence), and an exclusively-owned indexed page is
+  dropped from the index before the write lands;
+* releasing the last reference to an indexed page parks it on a
+  **cached LRU** instead of the free list — the prefix survives its
+  author, and the allocator reclaims cached pages (oldest first) only
+  when the free list runs dry.
+
+``free_pages()`` therefore counts *reclaimable* pages (free + cached), so
+admission control never refuses a request that evicting cold prefixes
+could host. Page 0 is the reserved TRASH page: it is never allocated,
+table sentinels (-1) clamp onto it inside the kernels, and dead-slot
+writes land there — a padded batch row can never corrupt a live
+sequence's pages.
 """
 
 from __future__ import annotations
 
-from typing import NamedTuple, Optional, Tuple
+from collections import OrderedDict
+from typing import List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -37,7 +61,36 @@ from deepspeed_tpu.models.config import TransformerConfig
 
 TRASH_PAGE = 0
 
+# root of every prefix hash chain (arbitrary constant; only equality of
+# chain keys matters, and keys are process-local like python hash())
+_ROOT_CHAIN = 0x9E3779B9
+
 _DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float16": jnp.float16}
+
+
+def _copy_page(k_pages, v_pages, src, dst):
+    """Copy page ``src`` over page ``dst`` in both pools — jitted with the
+    pools DONATED, so XLA aliases them in place and a CoW event costs one
+    page's bytes, not a rebuild of the whole cache."""
+    kp = jax.lax.dynamic_index_in_dim(k_pages, src, axis=1, keepdims=True)
+    vp = jax.lax.dynamic_index_in_dim(v_pages, src, axis=1, keepdims=True)
+    return (
+        jax.lax.dynamic_update_slice_in_dim(k_pages, kp, dst, axis=1),
+        jax.lax.dynamic_update_slice_in_dim(v_pages, vp, dst, axis=1),
+    )
+
+
+# one compiled copier per (shape, dtype) — shared across pools
+_copy_page_cache: dict = {}
+
+
+def _copy_page_fn(k_pages):
+    key = (k_pages.shape, str(k_pages.dtype))
+    fn = _copy_page_cache.get(key)
+    if fn is None:
+        fn = jax.jit(_copy_page, donate_argnums=(0, 1))
+        _copy_page_cache[key] = fn
+    return fn
 
 
 class PagedKVCache(NamedTuple):
@@ -84,9 +137,16 @@ class PagePool:
     A *slot* is one concurrently-running sequence (a row of the serving
     batch); each slot owns a page-table row of ``max_pages_per_slot``
     entries. ``seq_lens[slot]`` counts tokens already written. Sequences
-    acquire pages lazily as they grow and return them on ``free_slot`` —
+    acquire pages lazily as they grow and release them on ``free_slot`` —
     total cache HBM is fixed at ``num_pages``, but the *live* footprint is
-    ``used_pages × page_size × bytes_per_token``.
+    ``used_pages × page_size × bytes_per_token``. Pages are refcounted:
+    prefix sharing lets one page appear in many tables, and a page only
+    becomes reclaimable when its last reference drops.
+
+    Every mutation of the page tables, free list, refcounts, or prefix
+    index goes through the pool's own methods — lint DS-R007 flags outside
+    writes, because a bypassed write barrier corrupts the CoW/refcount
+    invariants silently.
     """
 
     def __init__(
@@ -111,6 +171,24 @@ class PagePool:
         self.page_table = np.full((max_slots, self.max_pages_per_slot), -1, np.int32)
         self.seq_lens = np.zeros(max_slots, np.int32)
         self._owned = np.zeros(max_slots, np.int32)  # pages held per slot
+        # --- prefix sharing state ---------------------------------------
+        self._refcount = np.zeros(num_pages, np.int32)  # table refs per page
+        self._hash_index: dict = {}  # chain key -> page id (full-page content)
+        self._page_hash: dict = {}  # page id -> chain key (reverse map)
+        self._cached: "OrderedDict[int, None]" = OrderedDict()  # ref-0 indexed, LRU
+        # per slot: chain key per leading full page whose content-chain is
+        # known (published, or found already indexed under another page)
+        self._chain_keys: List[List[int]] = [[] for _ in range(max_slots)]
+        self.stats = {
+            "prefix_lookups": 0,
+            "prefix_query_tokens": 0,  # prompt tokens offered to match_prefix
+            "prefix_hit_tokens": 0,  # tokens served by attaching cached pages
+            "prefix_hit_pages": 0,
+            "registered_pages": 0,
+            "cow_copies": 0,
+            "index_invalidations": 0,  # exclusive indexed pages rewritten
+            "cache_evictions": 0,  # cold cached pages reclaimed for allocation
+        }
 
     # --- capacity accounting -------------------------------------------
     @property
@@ -118,10 +196,17 @@ class PagePool:
         return self.cache.num_pages
 
     def free_pages(self) -> int:
-        return len(self._free)
+        """Reclaimable pages: truly free plus cached (refcount-0 prefix
+        pages the allocator may evict on demand)."""
+        return len(self._free) + len(self._cached)
 
     def used_pages(self) -> int:
-        return self.num_pages - 1 - len(self._free)  # trash page excluded
+        """Pages referenced by at least one live slot (trash page and
+        cached-but-unreferenced prefix pages excluded)."""
+        return self.num_pages - 1 - self.free_pages()
+
+    def cached_pages(self) -> int:
+        return len(self._cached)
 
     def pages_for(self, n_tokens: int) -> int:
         return -(-int(n_tokens) // self.page_size)
@@ -134,26 +219,161 @@ class PagePool:
         return self.used_pages() * self.page_size * self.cache.bytes_per_token
 
     def utilization(self) -> float:
-        """Live tokens over allocated page capacity (1.0 = no page waste)."""
+        """Live tokens over allocated page capacity (1.0 = no page waste;
+        prefix sharing can push it past 1.0 — N sequences reading one
+        page's tokens count N times against a single allocation)."""
         cap = self.used_pages() * self.page_size
         return self.live_tokens() / cap if cap else 0.0
 
+    def set_cache(self, new_k: jax.Array, new_v: jax.Array) -> None:
+        """Install the page arrays a serving program returned (the donated
+        buffers aliased in place). The one sanctioned external write."""
+        self.cache = PagedKVCache(k_pages=new_k, v_pages=new_v)
+
+    # --- page acquisition / release -------------------------------------
+    def _acquire_page(self) -> Optional[int]:
+        """One page off the free list, or — when it is dry — the coldest
+        cached prefix page, dropped from the index first."""
+        if self._free:
+            return self._free.pop()
+        if self._cached:
+            page, _ = self._cached.popitem(last=False)  # oldest first
+            self._drop_index(int(page))
+            self.stats["cache_evictions"] += 1
+            return int(page)
+        return None
+
+    def _release_page(self, page: int) -> None:
+        """Last reference dropped: indexed pages park on the cached LRU
+        (the prefix outlives its author), the rest return to the free list."""
+        if page in self._page_hash:
+            self._cached[page] = None  # newest end of the LRU
+        else:
+            self._free.append(page)
+
+    def _drop_index(self, page: int) -> None:
+        key = self._page_hash.pop(page, None)
+        if key is not None and self._hash_index.get(key) == page:
+            del self._hash_index[key]
+
+    # --- prefix index ----------------------------------------------------
+    def _block_key(self, chain: int, block: np.ndarray) -> int:
+        return hash((chain, np.ascontiguousarray(block, np.int32).tobytes()))
+
+    def match_prefix(self, tokens) -> List[Tuple[int, int]]:
+        """Longest indexed full-page prefix of ``tokens`` as
+        ``[(page_id, chain_key), ...]``. Capped at ``len(tokens) - 1``
+        tokens: at least one prompt token is always left to prefill, so the
+        request's first output token has logits to come from."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        P = self.page_size
+        max_blocks = min(max(tokens.size - 1, 0) // P, self.max_pages_per_slot)
+        out: List[Tuple[int, int]] = []
+        chain = _ROOT_CHAIN
+        for b in range(max_blocks):
+            key = self._block_key(chain, tokens[b * P : (b + 1) * P])
+            page = self._hash_index.get(key)
+            if page is None:
+                break
+            out.append((int(page), key))
+            chain = key
+        return out
+
+    def register_prefix(self, slot: int, tokens, upto: Optional[int] = None) -> int:
+        """Publish ``slot``'s leading full pages into the prefix index so
+        later requests can attach them. ``tokens`` is the slot's canonical
+        context (prompt + accepted tokens); pages holding ``tokens[:upto]``
+        (default: the slot's live length) are hashed block-by-block chained
+        on the prefix. Incremental — pages already chained are skipped, so
+        the per-step cost is one hash per newly-FILLED page. Returns the
+        number of full pages chained. When a block's content is already
+        indexed under another page, the existing entry wins (first writer)
+        and this slot's page stays private."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        live = int(self.seq_lens[slot])
+        upto = live if upto is None else min(int(upto), live, tokens.size)
+        P = self.page_size
+        n_full = upto // P
+        chain_list = self._chain_keys[slot]
+        chain = chain_list[-1] if chain_list else _ROOT_CHAIN
+        i = len(chain_list)
+        while i < n_full:
+            key = self._block_key(chain, tokens[i * P : (i + 1) * P])
+            page = int(self.page_table[slot, i])
+            if key not in self._hash_index and page not in self._page_hash:
+                self._hash_index[key] = page
+                self._page_hash[page] = key
+                self.stats["registered_pages"] += 1
+            chain_list.append(key)
+            chain = key
+            i += 1
+        return n_full
+
+    def prefix_stats(self) -> dict:
+        """Counters + derived prefix observability for ``serve_stats()``:
+        ``prefix_hit_rate`` = fraction of looked-up prompt tokens served by
+        attaching already-cached pages."""
+        s = dict(self.stats)
+        s["indexed_pages"] = len(self._page_hash)
+        s["cached_pages"] = len(self._cached)
+        q = s["prefix_query_tokens"]
+        s["prefix_hit_rate"] = s["prefix_hit_tokens"] / q if q else 0.0
+        return s
+
     # --- slot lifecycle -------------------------------------------------
     def can_admit(self, n_tokens: int) -> bool:
-        """A free slot exists and the pool can hold ``n_tokens`` now."""
+        """A free slot exists and the pool can hold ``n_tokens`` now
+        (before any prefix credit — attaching cached pages only helps)."""
         return (
             bool(self._free_slots)
             and n_tokens <= self.max_seq_len
             and self.pages_for(n_tokens) <= self.free_pages()
         )
 
-    def alloc_slot(self, n_tokens: int = 0) -> Optional[int]:
+    def alloc_slot(self, n_tokens: int = 0, prefix_tokens=None) -> Optional[int]:
         """Claim a slot, pre-reserving pages for ``n_tokens``; None if the
-        pool cannot host it right now (caller keeps the request queued)."""
-        if not self.can_admit(max(n_tokens, 1)):
+        pool cannot host it right now (caller keeps the request queued).
+
+        With ``prefix_tokens`` (the request's context) the longest indexed
+        full-page prefix is ATTACHED first: the shared pages enter the page
+        table with their refcount raised, ``seq_lens[slot]`` starts at the
+        attached length, and only the remainder draws fresh pages — N
+        requests sharing a system prompt allocate (and prefill) its KV
+        exactly once."""
+        if not self._free_slots:
+            return None
+        want = max(int(n_tokens), 1)
+        if want > self.max_seq_len:
+            return None
+        matched: List[Tuple[int, int]] = []
+        if prefix_tokens is not None:
+            matched = self.match_prefix(prefix_tokens)
+        # attached cached pages leave the reclaimable set, so discount them
+        fresh = self.pages_for(want) - len(matched)
+        avail = self.free_pages() - sum(1 for p, _ in matched if p in self._cached)
+        if fresh > avail:
             return None
         slot = self._free_slots.pop()
+        if prefix_tokens is not None:
+            # counted only on successful admission: a stalled request retried
+            # every step must not dilute the reported hit rate
+            self.stats["prefix_lookups"] += 1
+            self.stats["prefix_query_tokens"] += int(
+                np.asarray(prefix_tokens).reshape(-1).size
+            )
         self.seq_lens[slot] = 0
+        self._chain_keys[slot] = []
+        for i, (page, key) in enumerate(matched):
+            self.page_table[slot, i] = page
+            if self._refcount[page] == 0:
+                self._cached.pop(page, None)
+            self._refcount[page] += 1
+            self._owned[slot] += 1
+            self._chain_keys[slot].append(key)
+        if matched:
+            self.seq_lens[slot] = len(matched) * self.page_size
+            self.stats["prefix_hit_pages"] += len(matched)
+            self.stats["prefix_hit_tokens"] += len(matched) * self.page_size
         if n_tokens and not self.ensure(slot, n_tokens):
             self.free_slot(slot)
             return None
@@ -162,17 +382,77 @@ class PagePool:
     def ensure(self, slot: int, new_len: int) -> bool:
         """Grow ``slot``'s table to cover ``new_len`` tokens. All-or-nothing:
         on a pool-exhausted failure nothing is allocated (the caller decides
-        whom to preempt and retries)."""
+        whom to preempt and retries). Cold cached prefix pages are evicted
+        (oldest first) when the free list alone cannot cover the growth."""
         if new_len > self.max_seq_len:
             return False
         need = self.pages_for(new_len) - self._owned[slot]
         if need <= 0:
             return True
-        if need > len(self._free):
+        if need > self.free_pages():
             return False
         for _ in range(int(need)):
-            self.page_table[slot, self._owned[slot]] = self._free.pop()
+            page = self._acquire_page()
+            self.page_table[slot, self._owned[slot]] = page
+            self._refcount[page] = 1
             self._owned[slot] += 1
+        return True
+
+    def prepare_write(self, slot: int, new_len: int) -> bool:
+        """Write barrier: make positions ``[seq_lens[slot], new_len)``
+        writable, then guarantee every page in that span is EXCLUSIVE and
+        UNINDEXED. Shared pages (refcount > 1 — a prefix some other
+        sequence still reads) are replaced by private copy-on-write
+        duplicates; exclusively-owned pages still in the index are dropped
+        from it (an indexed page's content is immutable, and it is about
+        to change). All-or-nothing like ``ensure``: False means nothing
+        was allocated or copied and the caller should preempt and retry.
+        Serving schedulers must call this (not bare ``ensure``) before
+        every dispatch that writes KV."""
+        cur = int(self.seq_lens[slot])
+        if new_len > self.max_seq_len:
+            return False
+        if new_len <= cur:
+            return True
+        P = self.page_size
+        first = cur // P
+        last_w = (new_len - 1) // P
+        owned = int(self._owned[slot])
+        span = range(first, min(last_w + 1, owned))
+        shared = [
+            i for i in span if self._refcount[self.page_table[slot, i]] > 1
+        ]
+        grow = max(self.pages_for(new_len) - owned, 0)
+        if grow + len(shared) > self.free_pages():
+            return False
+        if not self.ensure(slot, new_len):
+            return False
+        for i in shared:
+            src = int(self.page_table[slot, i])
+            dst = self._acquire_page()
+            # one donated in-place page copy per divergence event — never
+            # per step, and never a rebuild of the whole cache
+            copy = _copy_page_fn(self.cache.k_pages)
+            new_k, new_v = copy(
+                self.cache.k_pages, self.cache.v_pages,
+                jnp.int32(src), jnp.int32(dst),
+            )
+            self.cache = PagedKVCache(k_pages=new_k, v_pages=new_v)
+            self.page_table[slot, i] = dst
+            self._refcount[dst] = 1
+            self._refcount[src] -= 1
+            if self._refcount[src] == 0:
+                self._release_page(src)
+            self.stats["cow_copies"] += 1
+        for i in span:
+            page = int(self.page_table[slot, i])
+            if page in self._page_hash:
+                self._drop_index(page)
+                self.stats["index_invalidations"] += 1
+        # pages from the first written one on are no longer a published
+        # prefix of this slot
+        if first < len(self._chain_keys[slot]):
+            del self._chain_keys[slot][first:]
         return True
 
     def advance(self, slot: int, n_tokens: int) -> None:
@@ -187,11 +467,14 @@ class PagePool:
 
     def rollback(self, slot: int, n_tokens: int) -> int:
         """Un-write the last ``n_tokens`` of ``slot`` — speculative decode's
-        rejected draft tail: shrink the live length and return every page
-        past the new length to the free list (LIFO, so the tail pages are
-        the first reused). The data in the rolled-back region is NOT
+        rejected draft tail: shrink the live length and release every page
+        past the new length (refcount-aware: a still-shared page survives
+        for its other readers; an exclusive indexed page parks on the
+        cached LRU; the rest return to the free list LIFO, so tail pages
+        are the first reused). The data in the rolled-back region is NOT
         cleared — the length mask makes it invisible, and the next write at
-        those positions overwrites it. Returns how many pages came back."""
+        those positions overwrites it (through the write barrier). Returns
+        how many pages this slot released."""
         n_tokens = int(n_tokens)
         new_len = int(self.seq_lens[slot]) - n_tokens
         if n_tokens < 0 or new_len < 0:
@@ -205,35 +488,51 @@ class PagePool:
         while self._owned[slot] > keep:
             self._owned[slot] -= 1
             i = int(self._owned[slot])
-            self._free.append(int(self.page_table[slot, i]))
+            page = int(self.page_table[slot, i])
             self.page_table[slot, i] = -1
+            self._refcount[page] -= 1
+            if self._refcount[page] == 0:
+                self._release_page(page)
             freed += 1
+        del self._chain_keys[slot][min(len(self._chain_keys[slot]), keep):]
         return freed
 
     def free_slot(self, slot: int) -> int:
-        """Release the slot and return its pages to the pool; returns how
-        many pages came back."""
+        """Release the slot and drop its page references (pages whose last
+        reference this was go back to the pool — or to the cached LRU when
+        they still serve the prefix index); returns how many pages the slot
+        held."""
         n = int(self._owned[slot])
         for i in range(n):
-            self._free.append(int(self.page_table[slot, i]))
+            page = int(self.page_table[slot, i])
+            self._refcount[page] -= 1
+            if self._refcount[page] == 0:
+                self._release_page(page)
         self.page_table[slot, :] = -1
         self.seq_lens[slot] = 0
         self._owned[slot] = 0
+        self._chain_keys[slot] = []
         self._free_slots.append(slot)
         return n
 
     # --- maintenance ----------------------------------------------------
     def defrag(self) -> int:
         """Compact live pages into the lowest ids (one device gather per
-        K/V), rewriting tables and rebuilding the free list. Keeps the hot
-        working set dense — e.g. so a checkpointed/snapshotted pool prefix
-        of ``used_pages + 1`` pages captures every live token. Returns the
-        number of pages that moved."""
-        live = [
-            int(self.page_table[s, i])
-            for s in range(self.max_slots)
-            for i in range(int(self._owned[s]))
-        ]
+        K/V), rewriting tables, refcounts, and the prefix index, and
+        rebuilding the free list. Live = referenced by any slot OR parked
+        on the cached LRU (their bytes still serve future prefix matches).
+        Shared pages move once and every referencing table row follows.
+        Returns the number of pages that moved."""
+        live: List[int] = []
+        seen = set()
+        for s in range(self.max_slots):
+            for i in range(int(self._owned[s])):
+                p = int(self.page_table[s, i])
+                if p not in seen:
+                    seen.add(p)
+                    live.append(p)
+        for p in self._cached:  # refcount 0: never in a table
+            live.append(int(p))
         perm = np.arange(self.num_pages, dtype=np.int32)  # new_id -> old_id
         remap = {}  # old_id -> new_id
         nxt = TRASH_PAGE + 1
@@ -255,6 +554,13 @@ class PagePool:
         for s in range(self.max_slots):
             for i in range(int(self._owned[s])):
                 self.page_table[s, i] = remap[int(self.page_table[s, i])]
+        new_rc = np.zeros_like(self._refcount)
+        for old, new in remap.items():
+            new_rc[new] = self._refcount[old]
+        self._refcount = new_rc
+        self._page_hash = {remap[p]: k for p, k in self._page_hash.items()}
+        self._hash_index = {k: remap[p] for k, p in self._hash_index.items()}
+        self._cached = OrderedDict((remap[int(p)], None) for p in self._cached)
         self._free = list(range(self.num_pages - 1, nxt - 1, -1))
         return moves
 

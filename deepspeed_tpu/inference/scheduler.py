@@ -20,20 +20,34 @@ vLLM style):
   ``mean accepted + 1`` tokens per dispatch, the rejected tail's pages roll
   back to the free list, and greedy outputs stay byte-identical to
   speculation-off serving (the verify program argmax-compares in-program);
+* with **prefix caching** enabled the pool's hash-of-block index
+  (``inference/kv_pool.py``) is consulted at admission: the longest cached
+  full-page prefix of the request's context attaches by reference (its KV
+  pays nothing), prefill resumes after it realigned to the cold-prefill
+  chunk grid (so every position is computed by the same (chunk, row)
+  geometry — byte-identical streams), and each newly filled full page is
+  published back to the index;
 * compiled-program count is bounded by the **slot-count buckets** (× the
   **spec lengths** when speculating): each round dispatches ONE program
   shaped to the smallest bucket covering the running set, and each prompt
   chunk one fixed-chunk prefill program. Steady state is one dispatch per
   round, ≤1 compile per (bucket[, spec length]) — enforced by the serving
-  tests via the engine's compile telemetry.
+  tests via the engine's compile telemetry. Prefix sharing adds zero
+  dispatches and zero programs: attach/register are host-side table and
+  hash work;
+* admission order and preemption victims are delegated to a
+  ``SchedulingPolicy`` (default: FIFO admission, youngest-first
+  preemption — the original behavior). ``inference/traffic.py`` layers
+  SLA-aware multi-tenant scheduling on the same hooks.
 
 ``InferenceEngine.serve()`` (``inference/engine.py``) owns a ``PagedServer``
-configured from the ``inference.paged_kv`` + ``inference.spec_decode``
-knobs.
+configured from the ``inference.paged_kv`` + ``inference.spec_decode`` (+
+``inference.traffic``) knobs.
 """
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
@@ -45,7 +59,7 @@ from deepspeed_tpu.inference.decode import (
     build_paged_prefill,
     build_paged_verify_step,
 )
-from deepspeed_tpu.inference.kv_pool import PagedKVCache, PagePool
+from deepspeed_tpu.inference.kv_pool import PagePool
 from deepspeed_tpu.inference.spec_decode import Drafter, NGramDrafter
 from deepspeed_tpu.models.config import TransformerConfig
 
@@ -59,6 +73,42 @@ def _spec_knob(spec, name, default):
     return getattr(spec, name, default)
 
 
+class SchedulingPolicy:
+    """Admission-order / preemption-victim policy for ``PagedServer``.
+
+    The defaults reproduce the original single-policy behavior: FIFO
+    admission (head of the queue or nothing — no head-of-line bypass) and
+    youngest-first recompute preemption. ``inference/traffic.py``'s
+    ``SLAPolicy`` overrides these with per-tenant budget/priority
+    scheduling; the ``on_*`` hooks feed it the accounting."""
+
+    def next_admission(
+        self, queue: Sequence["Request"], server: "PagedServer"
+    ) -> Optional["Request"]:
+        return queue[0] if queue else None
+
+    def preemption_victim(
+        self,
+        candidates: Sequence["Request"],
+        server: "PagedServer",
+        for_req: Optional["Request"] = None,
+    ) -> "Request":
+        return candidates[-1]  # latest admission
+
+    def on_admit(self, req: "Request", server: "PagedServer") -> None:
+        pass
+
+    def on_emit(self, req: "Request", server: "PagedServer") -> None:
+        pass
+
+    def on_finish(self, req: "Request", server: "PagedServer") -> None:
+        pass
+
+
+class YoungestFirstPolicy(SchedulingPolicy):
+    """The original policy, by its name."""
+
+
 @dataclass
 class Request:
     """One generation request moving through the scheduler."""
@@ -67,12 +117,17 @@ class Request:
     prompt: np.ndarray  # [Lp] int32, immutable
     max_new_tokens: int
     eos_token_id: Optional[int] = None
+    tenant: str = "default"
     generated: List[int] = field(default_factory=list)
     slot: Optional[int] = None
     consumed: int = 0  # prefill progress over context()
     pending: Optional[int] = None  # sampled but not yet written token
     done: bool = False
     admissions: int = 0  # > 1 means the request was preempted and resumed
+    prefix_cached: int = 0  # context tokens attached from the prefix index
+    t_submit: float = 0.0  # server-clock timestamps for TTFT / TPOT
+    t_first: Optional[float] = None
+    t_finish: Optional[float] = None
     # capacity-doubling context buffer: context() sits on the serving hot
     # path (drafting reads it every speculative round), so appending the
     # newly emitted tokens must not re-concatenate the whole history
@@ -131,12 +186,20 @@ class PagedServer:
         telemetry=None,
         spec_decode=None,
         drafter: Optional[Drafter] = None,
+        prefix_cache: bool = False,
+        policy: Optional[SchedulingPolicy] = None,
+        clock=None,
     ):
         self.cfg = cfg
         self.params = params
         self.prefill_chunk = int(prefill_chunk)
         self.attn_impl = attn_impl
         self.telemetry = telemetry
+        self.prefix_cache = bool(prefix_cache)
+        self.policy = policy or YoungestFirstPolicy()
+        # injectable clock: TTFT/TPOT stamps and the load harness's virtual
+        # time both read it (default: wall)
+        self.clock = clock or time.perf_counter
         # speculation: a SpecDecodeConfig / dict of knobs, or an explicit
         # Drafter instance (tests inject oracles this way) — either enables
         self.max_draft = int(_spec_knob(spec_decode, "max_draft", 4))
@@ -188,10 +251,17 @@ class PagedServer:
         self._active: List[Request] = []  # admission order (oldest first)
         self._results: Dict[int, np.ndarray] = {}
         self._next_uid = 0
+        # per-tenant serving observability (created lazily per tenant name):
+        # request counters, emitted tokens, and bounded TTFT/TPOT samples
+        self._tenant_stats: Dict[str, Dict] = {}
+        # (tenant, ttft_ms, tpot_ms|None, n_tokens) per finished request —
+        # the load harness derives SLA goodput from this
+        self._finished_log: deque = deque(maxlen=65536)
         self.stats = {
             "admitted": 0,
             "preempted": 0,
             "finished": 0,
+            "prefix_cached_tokens": 0,  # context tokens attached, not prefilled
             "prefill_chunks": 0,
             "decode_steps": 0,  # plain (non-speculative) decode dispatches
             "spec_rounds": 0,  # verify dispatches (one per speculative round)
@@ -203,11 +273,34 @@ class PagedServer:
         }
 
     # --- request intake -------------------------------------------------
+    def _tenant(self, name: str) -> Dict:
+        ts = self._tenant_stats.get(name)
+        if ts is None:
+            ts = self._tenant_stats[name] = {
+                "submitted": 0,
+                "finished": 0,
+                "tokens": 0,
+                "ttft_ms": deque(maxlen=4096),
+                "tpot_ms": deque(maxlen=4096),
+            }
+        return ts
+
+    def queued_count(self, tenant: Optional[str] = None) -> int:
+        if tenant is None:
+            return len(self._queue)
+        return sum(1 for r in self._queue if r.tenant == tenant)
+
+    def live_count(self, tenant: Optional[str] = None) -> int:
+        if tenant is None:
+            return len(self._active)
+        return sum(1 for r in self._active if r.tenant == tenant)
+
     def submit(
         self,
         prompt,
         max_new_tokens: int = 32,
         eos_token_id: Optional[int] = None,
+        tenant: str = "default",
     ) -> int:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
@@ -229,8 +322,10 @@ class PagedServer:
         self._next_uid += 1
         self._queue.append(
             Request(uid=uid, prompt=prompt, max_new_tokens=int(max_new_tokens),
-                    eos_token_id=eos_token_id)
+                    eos_token_id=eos_token_id, tenant=tenant,
+                    t_submit=self.clock())
         )
+        self._tenant(tenant)["submitted"] += 1
         return uid
 
     def has_work(self) -> bool:
@@ -238,6 +333,11 @@ class PagedServer:
 
     def result(self, uid: int) -> Optional[np.ndarray]:
         return self._results.get(uid)
+
+    def take_result(self, uid: int) -> Optional[np.ndarray]:
+        """Pop a finished output: a long-lived server must not retain every
+        output ever generated (both ``serve()`` fronts drain through this)."""
+        return self._results.pop(uid, None)
 
     # --- one scheduler iteration ---------------------------------------
     def step(self) -> None:
@@ -257,6 +357,7 @@ class PagedServer:
         prompts: Sequence,
         max_new_tokens=32,
         eos_token_id: Optional[int] = None,
+        tenant: str = "default",
     ) -> List[np.ndarray]:
         """Submit a batch (scalar or per-request ``max_new_tokens``), run to
         completion, return outputs in submission order."""
@@ -267,31 +368,49 @@ class PagedServer:
                 f"{len(prompts)} prompts but {len(max_new_tokens)} max_new_tokens"
             )
         uids = [
-            self.submit(p, max_new_tokens=int(n), eos_token_id=eos_token_id)
+            self.submit(p, max_new_tokens=int(n), eos_token_id=eos_token_id,
+                        tenant=tenant)
             for p, n in zip(prompts, max_new_tokens)
         ]
         self.run()
-        # pop: the server lives as long as the engine, and a per-batch
-        # serve() loop must not retain every output ever generated
-        return [self._results.pop(u) for u in uids]
+        return [self.take_result(u) for u in uids]
 
     # --- phases ---------------------------------------------------------
     def _admit(self) -> None:
         while self._queue:
-            req = self._queue[0]
-            ctx_len = req.prompt.size + len(req.generated)
+            # the deque is handed to the policy directly (policies iterate /
+            # peek, never mutate); the FIFO default peeks [0] so the common
+            # path stays O(1) via popleft below
+            req = self.policy.next_admission(self._queue, self)
+            if req is None:
+                break
+            ctx = req.context()
             # reserve the whole context plus the first decode write so a
-            # prefill can never die halfway through its own prompt
-            slot = self.pool.alloc_slot(ctx_len + 1)
+            # prefill can never die halfway through its own prompt; with
+            # prefix caching the pool first attaches the longest indexed
+            # prefix of the context by reference (match is capped to
+            # ctx.size - 1, so at least one token always prefills and the
+            # first output token has logits to come from)
+            slot = self.pool.alloc_slot(
+                ctx.size + 1,
+                prefix_tokens=ctx if self.prefix_cache else None,
+            )
             if slot is None:
                 break
-            self._queue.popleft()
+            if self._queue[0] is req:
+                self._queue.popleft()
+            else:
+                self._queue.remove(req)
             req.slot = slot
-            req.consumed = 0
+            cached = int(self.pool.seq_lens[slot])
+            req.consumed = cached
+            req.prefix_cached = cached
+            self.stats["prefix_cached_tokens"] += cached
             req.pending = None
             req.admissions += 1
             self._active.append(req)
             self.stats["admitted"] += 1
+            self.policy.on_admit(req, self)
 
     def _prefill_step(self) -> None:
         C = self.prefill_chunk
@@ -303,6 +422,20 @@ class PagedServer:
             ctx = req.context()
             start = req.consumed
             real = min(C, ctx.size - start)
+            if start % C:
+                # a prefix attach landed mid chunk-grid: realign to the
+                # cold-prefill chunk boundaries so every position is
+                # computed by the same (chunk, row) geometry as
+                # sharing-off serving — byte-identical streams by
+                # construction
+                real = min(real, C - start % C)
+            if not self.pool.prepare_write(req.slot, start + real):
+                # unreachable: admission pre-reserved the whole context and
+                # prefill never writes into attached (shared) pages
+                raise RuntimeError(
+                    f"prefill write barrier failed for slot {req.slot} "
+                    f"({start}..{start + real})"
+                )
             chunk = np.zeros((1, C), np.int32)
             chunk[0, :real] = ctx[start : start + real]
             pt, _ = self.pool.rows([req.slot])
@@ -310,9 +443,11 @@ class PagedServer:
                 self.params, chunk, self.pool.cache.k_pages, self.pool.cache.v_pages,
                 pt, np.asarray([start], np.int32), np.int32(real - 1),
             )
-            self.pool.cache = PagedKVCache(k_pages=new_k, v_pages=new_v)
+            self.pool.set_cache(new_k, new_v)
             self.pool.advance(req.slot, real)
             req.consumed = start + real
+            if self.prefix_cache:
+                self.pool.register_prefix(req.slot, ctx, req.consumed)
             self.stats["prefill_chunks"] += 1
             if req.consumed == ctx.size:
                 # the chunk's single host fetch: the first generated token
@@ -332,16 +467,20 @@ class PagedServer:
         self._plain_decode_step(running)
 
     def _reserve_for_growth(self, running: List[Request], need: Dict[int, int]) -> List[Request]:
-        """Ensure every running row can write its next ``need[uid]`` tokens
-        (default 1), preempting the youngest active request (prefilling or
-        running) when the pool is dry — vLLM's recompute preemption: the
-        victim's greedy continuation is re-derived exactly on re-admission.
-        Mutates and returns ``running`` (preempted rows leave the round)."""
+        """Make every running row writable for its next ``need[uid]`` tokens
+        (default 1) — page growth plus the pool's copy-on-write barrier for
+        any shared prefix page in the written span — preempting the
+        policy's victim (default: youngest active request) when the pool is
+        dry; vLLM's recompute preemption: the victim's greedy continuation
+        is re-derived exactly on re-admission. Mutates and returns
+        ``running`` (preempted rows leave the round)."""
         idx = 0
         while idx < len(running):
             req = running[idx]
             grow = need.get(req.uid, 1)
-            while not self.pool.ensure(req.slot, int(self.pool.seq_lens[req.slot]) + grow):
+            while not self.pool.prepare_write(
+                req.slot, int(self.pool.seq_lens[req.slot]) + grow
+            ):
                 candidates = [r for r in self._active if r is not req]
                 if not candidates:
                     # unreachable while submit() validates total size, kept
@@ -351,7 +490,7 @@ class PagedServer:
                         f"{int(self.pool.seq_lens[req.slot])}): the pool holds "
                         f"{self.pool.num_pages - 1} pages x {self.pool.page_size} tokens"
                     )
-                victim = candidates[-1]  # latest admission
+                victim = self.policy.preemption_victim(candidates, self, for_req=req)
                 self._preempt(victim)
                 if victim in running:
                     vi = running.index(victim)
@@ -389,13 +528,19 @@ class PagedServer:
             self.params, tokens, self.pool.cache.k_pages, self.pool.cache.v_pages,
             page_table, lengths,
         )
-        self.pool.cache = PagedKVCache(k_pages=new_k, v_pages=new_v)
+        self.pool.set_cache(new_k, new_v)
         self.stats["decode_steps"] += 1
         # the step's single host fetch: [bucket] tokens
         out = np.asarray(out)  # lint: allow(DS-R005)
         for i, req in enumerate(running):
             self.pool.advance(req.slot, 1)
             self._emit(req, int(out[i]))
+            if self.prefix_cache and not req.done:
+                # publish any page this write just filled (incremental: one
+                # hash per P decode steps per request)
+                self.pool.register_prefix(
+                    req.slot, req.context(), int(self.pool.seq_lens[req.slot])
+                )
 
     # --- speculative rounds ---------------------------------------------
     def _propose_drafts(self, running: List[Request]) -> Dict[int, np.ndarray]:
@@ -444,7 +589,7 @@ class PagedServer:
             self.params, tokens, self.pool.cache.k_pages, self.pool.cache.v_pages,
             page_table, lengths, draft_lens,
         )
-        self.pool.cache = PagedKVCache(k_pages=new_k, v_pages=new_v)
+        self.pool.set_cache(new_k, new_v)
         self.stats["spec_rounds"] += 1
         # the round's single host fetch: [bucket, K+2] = accept count + the
         # greedy token after each prefix
@@ -465,14 +610,23 @@ class PagedServer:
                 self._emit(req, int(tok))
                 if req.done:  # EOS / budget inside the accepted run
                     break
+            if self.prefix_cache and not req.done:
+                # post-rollback length is the canonical accepted context
+                self.pool.register_prefix(
+                    req.slot, req.context(), int(self.pool.seq_lens[req.slot])
+                )
 
     # --- bookkeeping ----------------------------------------------------
     def _emit(self, req: Request, token: int) -> None:
         """Record a newly sampled token and retire the request if it just
         hit EOS or its budget (the token is included, matching
         ``decode.generate``'s output contract)."""
+        if req.t_first is None:
+            req.t_first = self.clock()
         req.generated.append(token)
         req.pending = token
+        self._tenant(req.tenant)["tokens"] += 1
+        self.policy.on_emit(req, self)
         if (
             req.eos_token_id is not None and token == req.eos_token_id
         ) or len(req.generated) >= req.max_new_tokens:
@@ -480,21 +634,53 @@ class PagedServer:
 
     def _finish(self, req: Request) -> None:
         req.done = True
+        req.t_finish = self.clock()
         self.pool.free_slot(req.slot)
         req.slot = None
         self._active.remove(req)
         self._results[req.uid] = req.output()
         self.stats["finished"] += 1
+        ts = self._tenant(req.tenant)
+        ts["finished"] += 1
+        ttft_ms = (req.t_first - req.t_submit) * 1e3
+        ts["ttft_ms"].append(ttft_ms)
+        tpot_ms = None
+        if len(req.generated) > 1:
+            tpot_ms = (req.t_finish - req.t_first) * 1e3 / (len(req.generated) - 1)
+            ts["tpot_ms"].append(tpot_ms)
+        self._finished_log.append((req.tenant, ttft_ms, tpot_ms, len(req.generated)))
+        self.policy.on_finish(req, self)
         if self.drafter is not None:
             self.drafter.drop(req.uid)
 
     # --- observability ---------------------------------------------------
+    @staticmethod
+    def _percentiles(values) -> Dict:
+        """{count, mean, p50, p99} ms summary ({} count 0 when empty)."""
+        vals = np.asarray(values, np.float64)
+        if vals.size == 0:
+            return {"count": 0}
+        return {
+            "count": int(vals.size),
+            "mean": float(vals.mean()),
+            "p50": float(np.percentile(vals, 50)),
+            "p99": float(np.percentile(vals, 99)),
+        }
+
+    def finished_log(self):
+        """Per-finished-request (tenant, ttft_ms, tpot_ms|None, n_tokens)
+        tuples, oldest first (bounded) — the load harness's goodput input."""
+        return list(self._finished_log)
+
     def serve_stats(self) -> Dict:
         """Scheduler counters plus derived speculation observability
         (acceptance rate, mean accepted drafts per round, draft-hit
-        histogram) and pool occupancy/utilization — the payload
-        ``InferenceEngine.serve_stats()`` surfaces and ``bench.py`` records
-        per serving config."""
+        histogram), pool occupancy/utilization, prefix-cache counters
+        (hit rate, CoW copies, cached pages), and latency SLOs — aggregate
+        and per-tenant p50/p99 TTFT (submit → first token, queue wait
+        included) and TPOT (per generated token after the first) — the
+        payload ``InferenceEngine.serve_stats()`` surfaces and ``bench.py``
+        records per serving config."""
         s = dict(self.stats)
         s["spec_accept_hist"] = list(self.stats["spec_accept_hist"])
         drafted, rounds = s["spec_drafted"], s["spec_rounds"]
@@ -509,6 +695,23 @@ class PagedServer:
             live_hbm_bytes=self.pool.live_hbm_bytes(),
             pool_utilization=self.pool.utilization(),
         )
+        all_ttft: List[float] = []
+        all_tpot: List[float] = []
+        tenants: Dict[str, Dict] = {}
+        for name, ts in self._tenant_stats.items():
+            all_ttft.extend(ts["ttft_ms"])
+            all_tpot.extend(ts["tpot_ms"])
+            tenants[name] = {
+                "submitted": ts["submitted"],
+                "finished": ts["finished"],
+                "tokens": ts["tokens"],
+                "ttft_ms": self._percentiles(ts["ttft_ms"]),
+                "tpot_ms": self._percentiles(ts["tpot_ms"]),
+            }
+        s["ttft_ms"] = self._percentiles(all_ttft)
+        s["tpot_ms"] = self._percentiles(all_tpot)
+        s["tenants"] = tenants
+        s["prefix"] = self.pool.prefix_stats()
         return s
 
     def _preempt(self, req: Request) -> None:

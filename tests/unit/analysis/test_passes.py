@@ -516,6 +516,71 @@ def test_green_spec_verify_programs():
 
 
 # ---------------------------------------------------------------------------
+# green sweep: the production-traffic serving path (ISSUE 6) — prefix
+# caching + multi-tenant scheduling must ride the SAME verified programs
+# ---------------------------------------------------------------------------
+def test_green_traffic_serving_programs():
+    """Serving through the traffic layer (prefix-cached pool + SLA tenant
+    scheduler) dispatches only the existing paged programs — donation
+    aliased, zero host transfers, zero violations — and sharing adds no
+    dispatches: decode dispatches == decode steps, prefill dispatches ==
+    prefill chunks, even with prefix attaches happening."""
+    from deepspeed_tpu.analysis import run_program_passes
+    from deepspeed_tpu.inference.scheduler import PagedServer
+    from deepspeed_tpu.inference.traffic import MultiTenantServer, TenantSpec
+    from deepspeed_tpu.models import TransformerLM
+    from deepspeed_tpu.models.config import TransformerConfig
+
+    cfg = TransformerConfig(
+        vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+        num_kv_heads=2, max_seq_len=64, norm="rmsnorm", position="rope",
+        activation="swiglu", use_bias=False, tie_embeddings=False,
+        flash_attention=False, dtype="float32",
+    )
+    model = TransformerLM(cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0, cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(0), toks)
+    tel = CompileTelemetry()
+    server = MultiTenantServer(
+        PagedServer(
+            cfg, params, page_size=8, max_slots=4, prefill_chunk=8,
+            attn_impl="xla", dtype=jnp.float32, telemetry=tel,
+            prefix_cache=True,
+        ),
+        tenants=[TenantSpec(name="a", weight=2.0), TenantSpec(name="b")],
+    )
+    rs = np.random.RandomState(0)
+    sys_tokens = rs.randint(0, 128, (16,)).astype(np.int32)  # 2 full pages
+    prompts = [
+        np.concatenate([sys_tokens, rs.randint(0, 128, (3 + i,)).astype(np.int32)])
+        for i in range(4)
+    ]
+    # the first serve publishes the shared pages, the second attaches them
+    server.serve(prompts[:1], max_new_tokens=4, tenant="a")
+    server.serve(prompts[1:], max_new_tokens=4, tenant=["b", "a", "b"])
+    assert server.pool.stats["prefix_hit_pages"] > 0  # sharing engaged
+    stats = tel.stats()
+    decode_dispatches = sum(
+        rec["dispatches"] for name, rec in stats.items()
+        if name.startswith("paged_decode_")
+    )
+    prefill_dispatches = sum(
+        rec["dispatches"] for name, rec in stats.items()
+        if name.startswith("paged_prefill_")
+    )
+    assert decode_dispatches == server.stats["decode_steps"]
+    assert prefill_dispatches == server.stats["prefill_chunks"]
+    assert all(n.startswith("paged_") for n in stats), stats.keys()
+    rep = run_program_passes(tel)
+    t = rep["totals"]
+    assert t["analysis_failures"] == 0 and t["violations"] == 0, rep
+    assert t["donation_verified"] is True
+    for name, prog in rep["programs"].items():
+        assert prog["passes"]["host_transfer"]["ok"], name
+        assert prog["passes"]["donation"]["ok"], name
+
+
+# ---------------------------------------------------------------------------
 # jaxpr shape scan (the paged-attention structural guard's engine)
 # ---------------------------------------------------------------------------
 def test_find_aval_shapes_sees_through_control_flow():

@@ -244,6 +244,58 @@ def test_r006_pragma_suppresses():
     """)
 
 
+def test_r007_pool_internal_writes_flagged():
+    """Direct mutation of PagePool state outside the pool — table writes,
+    free-list surgery, refcount pokes, index edits, cache rebinds — each
+    bypasses the CoW/refcount write barrier."""
+    rules = _rules("""
+        import numpy as np
+        class Scheduler:
+            def step(self, pool, slot, page):
+                pool.page_table[slot, 0] = page
+                pool.seq_lens[slot] = 4
+                pool._free.append(page)
+                pool._refcount[page] += 1
+                pool._hash_index.clear()
+                self.pool.cache = None
+    """)
+    assert rules.count("DS-R007") == 6
+
+
+def test_r007_quiet_inside_pool_and_on_reads():
+    """The pool's own methods are the sanctioned writers; reads and
+    non-pool receivers with generic attr names stay out of scope."""
+    assert "DS-R007" not in _rules("""
+        import numpy as np
+        class PagePool:
+            def free_slot(self, slot):
+                self.page_table[slot, :] = -1
+                self.seq_lens[slot] = 0
+                self._free.append(3)
+                self._refcount[3] -= 1
+        class SubPool(PagePool):
+            def reset(self):
+                self._hash_index.clear()
+        def reader(pool, slot):
+            return pool.page_table[slot], pool.seq_lens[slot]
+        class Engine:
+            def warm(self):
+                self.cache = {}         # generic attr, non-pool receiver
+                self._free = [1, 2]     # ditto
+    """)
+
+
+def test_r007_pragma_suppresses_and_is_error_severity():
+    findings = lint_source(textwrap.dedent("""
+        def restore(pool, table):
+            pool.page_table[:] = table  # lint: allow(DS-R007)
+            pool.seq_lens[:] = 0
+    """), path="deepspeed_tpu/foo.py")
+    r007 = [f for f in findings if f.rule == "DS-R007"]
+    assert len(r007) == 1  # the pragma'd line is suppressed
+    assert resolve_severity(r007[0]) == "error"
+
+
 def test_severity_tests_path_is_warn_only():
     f = lint_source("import jax.numpy as jnp\nx = jnp.repeat(k_cache, 2)\n", path="tests/unit/foo.py")[0]
     assert f.rule == "DS-R001"

@@ -23,6 +23,12 @@
 # set (zero_stage_trains[0-3] + zeropp qwZ/qgZ "did not learn in 5 steps"
 # rng flakes) is GONE: those tests now use deterministic learnable data +
 # a relative loss-decrease criterion — expect 0 failures on this box).
+# +production-traffic tests 2026-08-03 (test_traffic.py + extended
+# test_kv_pool.py): prefix-cache token-exactness vs sharing-off incl.
+# preemption, pages-allocated-once refcount accounting, CoW/invalidation,
+# randomized pool partition invariant, SLA no-starvation replay smoke
+# (2 tenants, shared prefix, flood-vs-trickle on a virtual clock),
+# admission control, DS-R007 lint, traffic green sweep.
 cd "$(dirname "$0")/.." || exit 1
 sh tools/lint.sh || exit 1
 exec python -m pytest -q \
@@ -38,6 +44,7 @@ exec python -m pytest -q \
   tests/unit/inference/test_kv_pool.py \
   tests/unit/inference/test_serving.py \
   tests/unit/inference/test_spec_decode.py \
+  tests/unit/inference/test_traffic.py \
   tests/unit/ops/test_paged_attention.py \
   tests/unit/ops/test_op_builder.py \
   tests/unit/parallel/test_mesh.py \
