@@ -157,10 +157,11 @@ def _compile_fields(engine):
         or {}
     )
     if not step:
-        # inference serving engines: the steady-state program is the paged
-        # decode step (one per slot bucket; dispatches sum to decode steps)
+        # inference serving engines: the steady-state program is the ragged
+        # step (≤2 programs, one dispatch per scheduler step) — or, on the
+        # bucketed oracle path, the paged decode step per slot bucket
         paged = [rec for name, rec in sorted(stats.items())
-                 if name.startswith("paged_decode_")]
+                 if name.startswith(("paged_ragged_", "paged_decode_"))]
         if paged:
             step = {"dispatches": sum(rec["dispatches"] for rec in paged)}
     return {
@@ -487,10 +488,15 @@ def bench_decode_serving():
     pool (``engine.serve()``) — generated tokens/s/chip on a ragged request
     mix, speculation OFF (``value``) and ON (``spec_on_value`` +
     ``spec_accept_rate``: n-gram drafting, one verify dispatch per round).
-    ``vs_baseline`` = paged serving throughput over the dense lockstep
-    ``generate`` on the same prompts padded to one max-budget batch (≥ ~1
-    means request-level batching serves ragged traffic at least as fast as
-    the fixed-shape batch that can't retire rows early);
+    The measured path is the RAGGED one-program dispatch (the default):
+    mixed prefill+decode rows share every step, ``compiled_programs``
+    (≤ 2 expected) and ``cold_start_compile_s`` record the collapsed
+    compile matrix, and ``bucketed_value`` / ``ragged_vs_bucketed`` replay
+    the same mixed traffic through the bucketed per-shape oracle for
+    comparison. ``vs_baseline`` = paged serving throughput over the dense
+    lockstep ``generate`` on the same prompts padded to one max-budget
+    batch (≥ ~1 means request-level batching serves ragged traffic at
+    least as fast as the fixed-shape batch that can't retire rows early);
     ``spec_vs_off`` = spec-on over spec-off (the drafter is model-free, so
     the ratio tracks how much repetitive structure the mix exposes ×
     acceptance — see PERF.md round 9 for the expected-speedup math)."""
@@ -547,7 +553,17 @@ def bench_decode_serving():
         gen = sum(len(o) - prompt_len for o in outs)
         return gen / (_time.perf_counter() - t0)
 
-    timed_serve()  # compile every bucket/chunk program
+    timed_serve()  # cold start: compiles the (≤2) ragged serving programs
+    # the collapsed compile matrix, measured at the cold boundary: program
+    # count and the wall time the first serve spent compiling
+    from deepspeed_tpu.inference.scheduler import compiled_serving_programs
+
+    cold_stats = engine.compile_stats()
+    compiled_programs = compiled_serving_programs(cold_stats)
+    cold_start_compile_s = sum(
+        rec["compile_seconds"] for name, rec in cold_stats.items()
+        if name.startswith("paged_")
+    )
     paged_tps = timed_serve()
     # serving SLOs + prefix-cache effectiveness of the measured (spec-off)
     # server: p50/p99 TTFT (submit -> first token, queue wait included) and
@@ -574,9 +590,19 @@ def bench_decode_serving():
     }
     engine._config.spec_decode.enable = False
     engine._paged_server = None
-    # snapshot BEFORE the dense baseline runs: the record's compile/analysis
-    # fields must describe the paged serving programs (decode + prefill +
-    # verify), not kv_decode_loop
+    # the same mixed prefill+decode traffic through the bucketed per-shape
+    # oracle (slot-bucket × chunk programs, prefill steps stealing from
+    # decode): the ragged_vs_bucketed ratio is the headline of ISSUE 8
+    engine._config.paged_kv.ragged = False
+    engine._paged_server = None
+    timed_serve()  # compile the bucketed program matrix
+    bucketed_tps = timed_serve()
+    engine._config.paged_kv.ragged = True
+    engine._paged_server = None
+    # snapshot AFTER the bucketed comparison and BEFORE the dense baseline
+    # runs: the record's compile/analysis fields describe every paged
+    # serving program (ragged + the bucketed comparison set), not
+    # kv_decode_loop
     compile_fields = _compile_fields(engine)
     compile_fields.update(_analysis_fields(engine))
 
@@ -596,6 +622,12 @@ def bench_decode_serving():
         "value": round(paged_tps, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(paged_tps / dense_tps, 4),
+        # the ragged one-program dispatch (ISSUE 8): collapsed compile
+        # matrix + the same mixed traffic through the bucketed oracle
+        "compiled_programs": int(compiled_programs),
+        "cold_start_compile_s": round(cold_start_compile_s, 3),
+        "bucketed_value": round(bucketed_tps, 1),
+        "ragged_vs_bucketed": round(paged_tps / bucketed_tps, 4),
         # serving SLO percentiles (TTFT includes queue wait; the headline
         # for serving is latency distribution, not aggregate tokens/s —
         # arXiv 2605.25645's TTFT/TPOT framing)

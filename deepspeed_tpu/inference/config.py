@@ -72,10 +72,21 @@ class PagedKVConfig(DeepSpeedConfigModel):
     ``num_pages = 0`` the pool is sized worst-case
     (``max_slots × ceil(max_seq_len / page_size) + 1``, preemption-free);
     set it lower to oversubscribe and trade HBM for recompute preemptions.
-    Compiled-program count is ``len(slot_buckets) + 1``: one decode program
-    per bucket, one prefill program per chunk size — plus
-    ``len(slot_buckets) × len(spec_lens)`` verify programs when
-    ``spec_decode.enable`` is set.
+
+    ``ragged`` (default ON) serves every step as ONE dispatch of the
+    unified ragged program (``decode.py:build_ragged_step``): mixed
+    prefill-chunk, decode, and verify rows ride together, driven by
+    per-row ``(kv_len, q_len)`` metadata arrays, so shifting traffic never
+    retraces and total compiled serving programs is ≤ 2 (the narrow
+    decode/verify width plus the mixed width covering prefill chunks) —
+    chunked prefill shares the dispatch with decoders instead of stealing
+    whole steps, and spec-K varies freely per request. With
+    ``ragged = False`` the bucketed per-shape programs are kept as the
+    token-exactness oracle: compiled-program count is then
+    ``len(slot_buckets) + 1`` (one decode program per bucket, one prefill
+    program per chunk size) plus ``len(slot_buckets) × len(spec_lens)``
+    verify programs when ``spec_decode.enable`` is set. Greedy streams
+    are byte-identical across the two paths.
 
     ``prefix_cache`` turns on page-level prefix sharing: full KV pages are
     indexed by a content chain hash, requests attach the longest cached
@@ -94,6 +105,7 @@ class PagedKVConfig(DeepSpeedConfigModel):
     prefill_chunk: int = 32  # prompt tokens per interleaved prefill dispatch
     attn_impl: str = "auto"  # auto | pallas | xla (decode attention backend)
     prefix_cache: bool = True  # page-level prefix sharing (hash-of-block + CoW)
+    ragged: bool = True  # one ragged program per step (False = bucketed oracle)
 
 
 class TenantConfig(DeepSpeedConfigModel):

@@ -560,13 +560,49 @@ def beam_generate(
 
 
 # --- paged (block-table) serving programs ----------------------------------
-# The continuous-batching scheduler (inference/scheduler.py) drives these:
-# per decode step ONE dispatch of a slot-bucket-sized program (or, with
-# speculation, ONE dispatch of a (bucket, K)-shaped verify program that
-# scores K drafted tokens plus the bonus token together); per prompt chunk
-# one dispatch of a fixed-chunk prefill program. Compiled-program count is
-# bounded by (slot buckets × spec lengths + slot buckets + chunk sizes),
-# never by traffic.
+# The continuous-batching scheduler (inference/scheduler.py) drives these.
+# Ragged mode (the default): ONE `build_ragged_step` program per step
+# handles mixed prefill-chunk, decode, and verify rows together, driven by
+# per-row (kv_len, q_len) metadata arrays — total compiled serving programs
+# ≤ 2 (a narrow decode/verify width plus the mixed width covering prefill
+# chunks). Bucketed mode (the token-exactness oracle): per decode step ONE
+# dispatch of a slot-bucket-sized program (or, with speculation, ONE
+# dispatch of a (bucket, K)-shaped verify program); per prompt chunk one
+# dispatch of a fixed-chunk prefill program — programs bounded by (slot
+# buckets × spec lengths + slot buckets + chunk sizes). Neither is ever
+# bounded by traffic.
+
+
+def _program_name(kind: str, rows: int, width: int) -> str:
+    """Unified serving-program name ``paged_<kind>_r<rows>_w<width>``: one
+    scheme across the decode / prefill / verify / ragged builders (decode
+    was keyed ``b<bucket>``, prefill ``c<chunk>``, verify
+    ``b<bucket>_k<K>`` before), so compile telemetry counts serving
+    programs consistently — the ragged ≤2-compile gate and the bench's
+    ``compiled_programs`` field both count ``paged_*`` entries."""
+    return f"paged_{kind}_r{int(rows)}_w{int(width)}"
+
+
+# one cache for every compiled serving program, keyed by the unified
+# program name + the build inputs that change lowering
+_paged_program_cache: Dict[Tuple, Any] = {}
+
+
+def _paged_program_key(name, cfg, page_size, attn_impl, telemetry) -> Tuple:
+    return (name, _cfg_key(cfg), int(page_size), attn_impl, _telemetry_uid(telemetry))
+
+
+def _accepted_prefix(tokens, greedy, n_drafts):
+    """Per-row count of leading drafts (``tokens[:, 1:]``) that match the
+    model's own greedy argmax for their positions, bounded by ``n_drafts``
+    — THE acceptance rule (argmax-compare ⇒ greedy outputs byte-identical
+    to sequential decode), shared by the bucketed verify program and the
+    ragged step so the oracle and the default path cannot drift."""
+    n_slots = tokens.shape[1] - 1
+    matches = (tokens[:, 1:] == greedy[:, :-1]) & (
+        jnp.arange(n_slots, dtype=jnp.int32)[None, :] < n_drafts[:, None]
+    )
+    return jnp.sum(jnp.cumprod(matches.astype(jnp.int32), axis=1), axis=1)
 
 
 def _scatter_pages(pages_l, vals, page_table, positions, page_size, valid=None):
@@ -592,7 +628,8 @@ def _scatter_pages(pages_l, vals, page_table, positions, page_size, valid=None):
 
 
 def _paged_forward(cfg, params, tokens, k_pages, v_pages, page_table, positions_b,
-                   attn_lens, attn_impl, write_valid=None, prefill_kv_lens=None):
+                   attn_lens, attn_impl, write_valid=None, prefill_kv_lens=None,
+                   ragged_q_lens=None):
     """Forward [B, T] tokens against the paged cache: scatter each token's
     k/v into its page, then attend — single-token rows (T == 1) through the
     paged decode kernel with live lengths ``attn_lens``, chunks through the
@@ -600,10 +637,14 @@ def _paged_forward(cfg, params, tokens, k_pages, v_pages, page_table, positions_
     ([B, T] bool) redirects masked positions' k/v writes to the trash page;
     ``prefill_kv_lens`` ([B]) additionally bounds the causal attention to
     each row's live kv prefix (the verify program's pad-slot safety).
-    Returns (logits [B, T, V], new_k_pages, new_v_pages)."""
+    ``ragged_q_lens`` ([B]) switches the attention to the unified ragged
+    entry (mixed prefill/decode/verify rows, per-row metadata — the
+    one-program serving step). Returns (logits [B, T, V], new_k_pages,
+    new_v_pages)."""
     from deepspeed_tpu.ops.transformer.paged_attention import (
         paged_decode_attention,
         paged_prefill_attention,
+        ragged_paged_attention,
     )
 
     B, T = tokens.shape
@@ -626,7 +667,12 @@ def _paged_forward(cfg, params, tokens, k_pages, v_pages, page_table, positions_
                               valid=write_valid)
         # attn_lens discriminates decode from prefill: a prefill_chunk=1
         # program also has T == 1 but must take the causal-mask path
-        if T == 1 and attn_lens is not None:
+        if ragged_q_lens is not None:
+            attn = ragged_paged_attention(
+                q, kp_l, vp_l, page_table, prefill_kv_lens, ragged_q_lens,
+                scale=scale, impl=attn_impl,
+            )
+        elif T == 1 and attn_lens is not None:
             attn = paged_decode_attention(
                 q[:, 0], kp_l, vp_l, page_table, attn_lens, scale=scale, impl=attn_impl
             )[:, None]
@@ -642,10 +688,6 @@ def _paged_forward(cfg, params, tokens, k_pages, v_pages, page_table, positions_
     return _final_logits(cfg, params, x), new_k, new_v
 
 
-_paged_decode_cache: Dict[Tuple, Any] = {}
-_paged_prefill_cache: Dict[Tuple, Any] = {}
-
-
 def build_paged_decode_step(cfg, bucket: int, page_size: int, attn_impl: str = "auto",
                             telemetry=None):
     """One-dispatch decode step for a ``bucket``-row slot batch.
@@ -659,8 +701,9 @@ def build_paged_decode_step(cfg, bucket: int, page_size: int, attn_impl: str = "
     """
     if cfg.position == "alibi":
         raise NotImplementedError("paged serving does not support alibi attention biases")
-    key = (_cfg_key(cfg), int(bucket), int(page_size), attn_impl, _telemetry_uid(telemetry))
-    fn = _paged_decode_cache.get(key)
+    name = _program_name("decode", bucket, 1)
+    key = _paged_program_key(name, cfg, page_size, attn_impl, telemetry)
+    fn = _paged_program_cache.get(key)
     if fn is not None:
         return fn
 
@@ -671,8 +714,8 @@ def build_paged_decode_step(cfg, bucket: int, page_size: int, attn_impl: str = "
         )
         return jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32), new_k, new_v
 
-    fn = _jit(_decode, telemetry, f"paged_decode_b{int(bucket)}", donate_argnums=(2, 3))
-    _paged_decode_cache[key] = fn
+    fn = _jit(_decode, telemetry, name, donate_argnums=(2, 3))
+    _paged_program_cache[key] = fn
     return fn
 
 
@@ -691,8 +734,9 @@ def build_paged_prefill(cfg, chunk: int, page_size: int, attn_impl: str = "auto"
     every real token."""
     if cfg.position == "alibi":
         raise NotImplementedError("paged serving does not support alibi attention biases")
-    key = (_cfg_key(cfg), int(chunk), int(page_size), attn_impl, _telemetry_uid(telemetry))
-    fn = _paged_prefill_cache.get(key)
+    name = _program_name("prefill", 1, chunk)
+    key = _paged_program_key(name, cfg, page_size, attn_impl, telemetry)
+    fn = _paged_program_cache.get(key)
     if fn is not None:
         return fn
 
@@ -708,12 +752,9 @@ def build_paged_prefill(cfg, chunk: int, page_size: int, attn_impl: str = "auto"
         last = jax.lax.dynamic_index_in_dim(logits, last_idx, axis=1, keepdims=False)
         return jnp.argmax(last, axis=-1).astype(jnp.int32), new_k, new_v
 
-    fn = _jit(_prefill, telemetry, f"paged_prefill_c{int(chunk)}", donate_argnums=(2, 3))
-    _paged_prefill_cache[key] = fn
+    fn = _jit(_prefill, telemetry, name, donate_argnums=(2, 3))
+    _paged_program_cache[key] = fn
     return fn
-
-
-_paged_verify_cache: Dict[Tuple, Any] = {}
 
 
 def build_paged_verify_step(cfg, bucket: int, K: int, page_size: int,
@@ -750,9 +791,9 @@ def build_paged_verify_step(cfg, bucket: int, K: int, page_size: int,
         raise NotImplementedError("paged serving does not support alibi attention biases")
     if K < 1:
         raise ValueError(f"speculative verify needs K >= 1 drafted slots, got {K}")
-    key = (_cfg_key(cfg), int(bucket), int(K), int(page_size), attn_impl,
-           _telemetry_uid(telemetry))
-    fn = _paged_verify_cache.get(key)
+    name = _program_name("verify", bucket, K + 1)
+    key = _paged_program_key(name, cfg, page_size, attn_impl, telemetry)
+    fn = _paged_program_cache.get(key)
     if fn is not None:
         return fn
 
@@ -770,19 +811,79 @@ def build_paged_verify_step(cfg, bucket: int, K: int, page_size: int,
             None, attn_impl, write_valid=valid, prefill_kv_lens=kv_lens,
         )
         greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, K+1]
-        # draft j is accepted iff every draft before it matched the model's
-        # greedy choice for its position (argmax-compare: greedy outputs are
-        # byte-identical to non-speculative decode)
-        matches = (tokens[:, 1:] == greedy[:, :-1]) & (
-            jnp.arange(K, dtype=jnp.int32)[None, :] < draft_lens[:, None]
-        )
-        accepted = jnp.sum(jnp.cumprod(matches.astype(jnp.int32), axis=1), axis=1)
+        accepted = _accepted_prefix(tokens, greedy, draft_lens)
         packed = jnp.concatenate([accepted[:, None].astype(jnp.int32), greedy], axis=1)
         return packed, new_k, new_v
 
-    fn = _jit(
-        _verify, telemetry, f"paged_verify_b{int(bucket)}_k{int(K)}",
-        donate_argnums=(2, 3),
-    )
-    _paged_verify_cache[key] = fn
+    fn = _jit(_verify, telemetry, name, donate_argnums=(2, 3))
+    _paged_program_cache[key] = fn
+    return fn
+
+
+def build_ragged_step(cfg, rows: int, width: int, page_size: int,
+                      attn_impl: str = "auto", telemetry=None):
+    """THE one serving program: a ``rows × width`` ragged step that handles
+    mixed prefill-chunk, decode, and verify rows in a single dispatch.
+
+    ``ragged_step(params, tokens [R, W], k_pages, v_pages,
+    page_table [R, MAXP], lengths [R], q_lens [R])
+    -> (out [R, W+1], k_pages, v_pages)``.
+
+    Row r carries ``q_lens[r]`` real tokens written at absolute positions
+    ``lengths[r] + j`` (``lengths`` = the row's live kv length BEFORE the
+    step — prefill progress and decode length coincide there). The mode is
+    pure data, never shape:
+
+    * a **prefill chunk** row is the next ``q_lens[r]`` prompt tokens;
+    * a **decode** row is the single pending token (``q_lens[r] == 1``);
+    * a **verify** row is the pending token plus ``q_lens[r] - 1`` drafts;
+    * a **dead** padding row has ``q_lens[r] == 0`` (sentinel table,
+      trash-page writes, zero attention).
+
+    The program scatters k/v for every real position (window slots past
+    ``q_lens[r]`` redirect to the trash page), attends through ONE ragged
+    paged-attention call driven by the per-row ``(kv_len, q_len)``
+    metadata, and resolves every mode in-program: ``out[r, 1 + j]`` is the
+    greedy token after position j (decode rows read ``out[r, 1]``, a
+    finishing prefill chunk reads ``out[r, q_lens[r]]``), and ``out[r, 0]``
+    is the verify rows' accepted-prefix length (count of leading drafts
+    matching the model's own greedy argmax — byte-identical to sequential
+    decode; 0 wherever nothing was drafted). Pages are donated; the packed
+    [R, W+1] fetch is the step's only host traffic.
+
+    Because slot count, chunk progress, spec-K, and the mode mix all ride
+    in as array contents, shifting traffic NEVER retraces: the scheduler
+    compiles at most two widths of this program (decode/verify width and
+    the mixed width covering prefill chunks) for an entire serve.
+    """
+    if cfg.position == "alibi":
+        raise NotImplementedError("paged serving does not support alibi attention biases")
+    if rows < 1 or width < 1:
+        raise ValueError(f"ragged step needs rows >= 1 and width >= 1, got {rows}x{width}")
+    name = _program_name("ragged", rows, width)
+    key = _paged_program_key(name, cfg, page_size, attn_impl, telemetry)
+    fn = _paged_program_cache.get(key)
+    if fn is not None:
+        return fn
+    W = int(width)
+
+    def _step(params, tokens, k_pages, v_pages, page_table, lengths, q_lens):
+        offs = jnp.arange(W, dtype=jnp.int32)
+        positions_b = lengths[:, None] + offs[None, :]
+        valid = offs[None, :] < q_lens[:, None]
+        kv_lens = jnp.where(q_lens > 0, lengths + q_lens, 0)
+        logits, new_k, new_v = _paged_forward(
+            cfg, params, tokens, k_pages, v_pages, page_table, positions_b,
+            None, attn_impl, write_valid=valid, prefill_kv_lens=kv_lens,
+            ragged_q_lens=q_lens,
+        )
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [R, W]
+        # verify resolution (inert elsewhere: decode rows have no drafts and
+        # prefill rows' accepted count is ignored by the host)
+        accepted = _accepted_prefix(tokens, greedy, q_lens - 1)
+        packed = jnp.concatenate([accepted[:, None].astype(jnp.int32), greedy], axis=1)
+        return packed, new_k, new_v
+
+    fn = _jit(_step, telemetry, name, donate_argnums=(2, 3))
+    _paged_program_cache[key] = fn
     return fn

@@ -497,11 +497,14 @@ class InferenceEngine:
         """Per-program compile telemetry snapshot — the inference-side
         counterpart of the training engine's ``compile_stats()``: for each
         jitted program (``forward``, ``kv_prefill`` / ``kv_decode_loop`` /
-        ``kv_beam_loop``, ``full_fwd_gen_step``, and the serving programs
-        ``paged_decode_b<bucket>`` / ``paged_prefill_c<chunk>``) the trace,
-        compile, and dispatch counters. The serving contract: ≤1 compile per
-        slot bucket and exactly one ``paged_decode_*`` dispatch per decode
-        step."""
+        ``kv_beam_loop``, ``full_fwd_gen_step``, and the serving programs —
+        ``paged_<kind>_r<rows>_w<width>`` across the ragged / decode /
+        prefill / verify builders) the trace, compile, and dispatch
+        counters. The serving contract under ``paged_kv.ragged`` (default):
+        ≤ 2 compiled ``paged_*`` programs for a whole mixed serve and
+        exactly one ``paged_ragged_*`` dispatch per scheduler step; under
+        the bucketed oracle, ≤1 compile per shape bucket and one
+        ``paged_decode_*`` dispatch per decode step."""
         return self._telemetry.stats()
 
     def analysis_report(self, programs=None, passes=None):
@@ -551,6 +554,7 @@ class InferenceEngine:
             telemetry=self._telemetry,
             spec_decode=self._config.spec_decode,
             prefix_cache=pcfg.prefix_cache,
+            ragged=pcfg.ragged,
         )
         tcfg = self._config.traffic
         if tcfg.enabled:
@@ -567,11 +571,13 @@ class InferenceEngine:
     def serve(self, prompts, max_new_tokens=32, eos_token_id=None):
         """Continuous-batching greedy generation over the paged KV pool:
         requests are admitted/evicted every step, prompts prefill in chunks
-        interleaved with decode, and each decode step is ONE dispatch of a
-        slot-bucket-shaped program (``inference/scheduler.py``). With
-        ``inference.spec_decode.enable`` the steps become speculative
-        rounds — host-side n-gram drafting plus a single draft-and-verify
-        dispatch per round, token-exact under greedy. Accepts a list of 1-D
+        riding the SAME dispatch as in-flight decoders, and each step is
+        ONE dispatch of the unified ragged program
+        (``inference/scheduler.py``; ``paged_kv.ragged=False`` falls back
+        to the bucketed per-shape programs, byte-identical streams). With
+        ``inference.spec_decode.enable`` host-side n-gram drafts verify
+        inside the same per-step dispatch (per-request spec-K), token-exact
+        under greedy. Accepts a list of 1-D
         prompts (ragged — no padding to a common length) and a scalar or
         per-request ``max_new_tokens``; returns one 1-D output array per
         request in submission order. The server (and its page pool)

@@ -27,14 +27,25 @@ vLLM style):
   chunk grid (so every position is computed by the same (chunk, row)
   geometry — byte-identical streams), and each newly filled full page is
   published back to the index;
-* compiled-program count is bounded by the **slot-count buckets** (× the
-  **spec lengths** when speculating): each round dispatches ONE program
-  shaped to the smallest bucket covering the running set, and each prompt
-  chunk one fixed-chunk prefill program. Steady state is one dispatch per
-  round, ≤1 compile per (bucket[, spec length]) — enforced by the serving
-  tests via the engine's compile telemetry. Prefix sharing adds zero
-  dispatches and zero programs: attach/register are host-side table and
-  hash work;
+* in **ragged** mode (the default) every scheduler step is ONE dispatch
+  of the unified ``build_ragged_step`` program: prefill chunks, pending
+  decode tokens, and drafted verify rows pack into a single
+  ``[max_slots, W]`` window whose per-row ``(kv_len, q_len)`` metadata
+  ride in as arrays (Ragged Paged Attention, arXiv 2604.15464) — so
+  chunked prefill COEXISTS with decoding instead of stealing steps,
+  spec-K varies per request, and shifting the mix never retraces. Total
+  compiled serving programs is ≤ 2 (the narrow decode/verify width plus
+  the chunk-covering mixed width), vs the bucketed matrix's dozens;
+* in **bucketed** mode (``ragged=False`` — kept as the token-exactness
+  oracle) compiled-program count is bounded by the **slot-count buckets**
+  (× the **spec lengths** when speculating): each round dispatches ONE
+  program shaped to the smallest bucket covering the running set, and
+  each prompt chunk one fixed-chunk prefill program. Steady state is one
+  dispatch per round, ≤1 compile per (bucket[, spec length]) — enforced
+  by the serving tests via the engine's compile telemetry. Greedy streams
+  are byte-identical across the two modes. Prefix sharing adds zero
+  dispatches and zero programs in either: attach/register are host-side
+  table and hash work;
 * admission order and preemption victims are delegated to a
   ``SchedulingPolicy`` (default: FIFO admission, youngest-first
   preemption — the original behavior). ``inference/traffic.py`` layers
@@ -58,6 +69,7 @@ from deepspeed_tpu.inference.decode import (
     build_paged_decode_step,
     build_paged_prefill,
     build_paged_verify_step,
+    build_ragged_step,
 )
 from deepspeed_tpu.inference.kv_pool import PagePool
 from deepspeed_tpu.inference.spec_decode import Drafter, NGramDrafter
@@ -71,6 +83,19 @@ def _spec_knob(spec, name, default):
     if isinstance(spec, dict):
         return spec.get(name, default)
     return getattr(spec, name, default)
+
+
+def compiled_serving_programs(compile_stats: Dict) -> int:
+    """Count the serving programs a telemetry snapshot saw compile: every
+    ``paged_*`` entry (the unified ``paged_<kind>_r<rows>_w<width>`` naming
+    across the decode/prefill/verify/ragged builders) with at least one
+    cold dispatch. The ragged compile-budget gate asserts this ≤ 2 for a
+    full mixed serve; ``bench.py`` records it as ``compiled_programs``."""
+    return sum(
+        1
+        for name, rec in compile_stats.items()
+        if name.startswith("paged_") and rec.get("compiles", 0) > 0
+    )
 
 
 class SchedulingPolicy:
@@ -189,6 +214,7 @@ class PagedServer:
         prefix_cache: bool = False,
         policy: Optional[SchedulingPolicy] = None,
         clock=None,
+        ragged: bool = True,
     ):
         self.cfg = cfg
         self.params = params
@@ -196,6 +222,12 @@ class PagedServer:
         self.attn_impl = attn_impl
         self.telemetry = telemetry
         self.prefix_cache = bool(prefix_cache)
+        # ragged (default): every step is ONE dispatch of the unified
+        # build_ragged_step program — mixed prefill/decode/verify rows,
+        # per-row (kv_len, q_len) metadata, ≤2 compiled programs total.
+        # ragged=False keeps the bucketed per-shape programs as the
+        # token-exactness oracle.
+        self.ragged = bool(ragged)
         self.policy = policy or YoungestFirstPolicy()
         # injectable clock: TTFT/TPOT stamps and the load harness's virtual
         # time both read it (default: wall)
@@ -230,7 +262,14 @@ class PagedServer:
                 "strict byte-identical guarantee vs speculation-off serving"
             )
         # drafts are clamped to the widest compiled verify program
+        # (bucketed) / the decode-row window width (ragged)
         self._draft_cap = min(self.max_draft, self.spec_lens[-1])
+        # the two ragged widths: decode/verify rows need 1 + draft_cap
+        # slots, prefill chunks need prefill_chunk — a step dispatches the
+        # narrow program unless it carries a chunk row, so total compiled
+        # serving programs is ≤ 2 regardless of traffic
+        self._ragged_w_decode = (self._draft_cap + 1) if self.drafter is not None else 1
+        self._ragged_w_mixed = max(self.prefill_chunk, self._ragged_w_decode)
         max_seq = int(max_seq_len or cfg.max_seq_len)
         if num_pages <= 0:
             # worst-case sizing: every slot at max length, plus the trash
@@ -263,6 +302,11 @@ class PagedServer:
             "finished": 0,
             "prefix_cached_tokens": 0,  # context tokens attached, not prefilled
             "prefill_chunks": 0,
+            # ragged mode: every scheduler step is ONE ragged dispatch;
+            # decode_steps / spec_rounds then count the dispatches that
+            # carried plain-decode / drafted rows (a mixed dispatch can
+            # count as both)
+            "ragged_steps": 0,
             "decode_steps": 0,  # plain (non-speculative) decode dispatches
             "spec_rounds": 0,  # verify dispatches (one per speculative round)
             "spec_drafted": 0,  # draft tokens sent to verification
@@ -341,11 +385,17 @@ class PagedServer:
 
     # --- one scheduler iteration ---------------------------------------
     def step(self) -> None:
-        """Admit what fits, push every pending prefill one chunk, run one
-        decode dispatch over the running set."""
+        """Admit what fits, then run the round's device work: in ragged
+        mode ONE dispatch covering every active row's next tokens (prefill
+        chunks, pending decodes, and drafted verifies together); in
+        bucketed mode one prefill dispatch per chunk followed by one
+        decode/verify dispatch over the running set."""
         self._admit()
-        self._prefill_step()
-        self._decode_step()
+        if self.ragged:
+            self._ragged_step()
+        else:
+            self._prefill_step()
+            self._decode_step()
 
     def run(self) -> Dict[int, np.ndarray]:
         while self.has_work():
@@ -412,6 +462,19 @@ class PagedServer:
             self.stats["admitted"] += 1
             self.policy.on_admit(req, self)
 
+    def _next_chunk_len(self, req: "Request", ctx_size: int) -> int:
+        """Tokens the request's next prefill chunk covers. A prefix attach
+        that landed mid chunk-grid realigns to the cold-prefill chunk
+        boundaries, so every position is computed by the same (chunk, row)
+        geometry as sharing-off serving — byte-identical streams by
+        construction."""
+        C = self.prefill_chunk
+        start = req.consumed
+        real = min(C, ctx_size - start)
+        if start % C:
+            real = min(real, C - start % C)
+        return real
+
     def _prefill_step(self) -> None:
         C = self.prefill_chunk
         prefill = build_paged_prefill(
@@ -421,14 +484,7 @@ class PagedServer:
         for req in [r for r in self._active if r.pending is None and not r.done]:
             ctx = req.context()
             start = req.consumed
-            real = min(C, ctx.size - start)
-            if start % C:
-                # a prefix attach landed mid chunk-grid: realign to the
-                # cold-prefill chunk boundaries so every position is
-                # computed by the same (chunk, row) geometry as
-                # sharing-off serving — byte-identical streams by
-                # construction
-                real = min(real, C - start % C)
+            real = self._next_chunk_len(req, ctx.size)
             if not self.pool.prepare_write(req.slot, start + real):
                 # unreachable: admission pre-reserved the whole context and
                 # prefill never writes into attached (shared) pages
@@ -466,6 +522,98 @@ class PagedServer:
             # dead slots — fall through to the plain one-token program
         self._plain_decode_step(running)
 
+    # --- the ragged one-program step -------------------------------------
+    def _ragged_step(self) -> None:
+        """ONE dispatch for the whole round: every active row contributes
+        its next tokens — a prefill chunk, the pending decode token, or the
+        pending token plus host-side drafts — packed into a single
+        ``[max_slots, W]`` window whose per-row ``(kv_len, q_len)`` metadata
+        ride in as arrays. A chunk row no longer steals a step from
+        decoders (they share the dispatch), spec-K varies freely per row,
+        and only the WIDTH can differ between steps (narrow decode/verify
+        vs chunk-covering mixed), bounding compiled programs at 2."""
+        rows = [r for r in self._active if not r.done]
+        if not rows:
+            return
+        drafts: Dict[int, np.ndarray] = {}
+        if self.drafter is not None:
+            drafts = self._propose_drafts([r for r in rows if r.pending is not None])
+        chunk_len: Dict[int, int] = {}
+        need: Dict[int, int] = {}
+        for r in rows:
+            if r.pending is None:
+                chunk_len[r.uid] = self._next_chunk_len(r, r.context().size)
+                need[r.uid] = chunk_len[r.uid]
+            else:
+                d = drafts.get(r.uid)
+                if d is None:
+                    d = drafts[r.uid] = np.zeros(0, np.int32)
+                need[r.uid] = d.size + 1
+        rows = self._reserve_for_growth(rows, need)
+        if not rows:
+            return
+        W = (
+            self._ragged_w_mixed
+            if any(r.pending is None for r in rows)
+            else self._ragged_w_decode
+        )
+        # pad to the single fixed row budget — never re-bucketed; lengths
+        # == consumed for prefill rows, so one write base serves every mode
+        R, page_table, lengths = self._dispatch_rows(rows, pad_to=self.pool.max_slots)
+        tokens = np.zeros((R, W), np.int32)
+        q_lens = np.zeros(R, np.int32)
+        for i, r in enumerate(rows):
+            if r.pending is None:
+                real = chunk_len[r.uid]
+                tokens[i, :real] = r.context()[r.consumed : r.consumed + real]
+                q_lens[i] = real
+            else:
+                d = drafts[r.uid]
+                tokens[i, 0] = r.pending
+                tokens[i, 1 : 1 + d.size] = d
+                q_lens[i] = 1 + d.size
+        step_fn = build_ragged_step(
+            self.cfg, R, W, self.pool.page_size, attn_impl=self.attn_impl,
+            telemetry=self.telemetry,
+        )
+        out, new_k, new_v = step_fn(
+            self.params, tokens, self.pool.cache.k_pages, self.pool.cache.v_pages,
+            page_table, lengths, q_lens,
+        )
+        self.pool.set_cache(new_k, new_v)
+        self.stats["ragged_steps"] += 1
+        # the step's single host fetch: [R, W+1] = accepted counts + the
+        # greedy token after each position
+        out = np.asarray(out)  # lint: allow(DS-R005)
+        had_decode = had_spec = False
+        for i, r in enumerate(rows):
+            if r.pending is None:
+                real = chunk_len[r.uid]
+                ctx = r.context()
+                self.pool.advance(r.slot, real)
+                r.consumed += real
+                self.stats["prefill_chunks"] += 1
+                if self.prefix_cache:
+                    self.pool.register_prefix(r.slot, ctx, r.consumed)
+                if r.consumed == ctx.size:
+                    # the first generated token: greedy after the chunk's
+                    # last real position
+                    self._emit(r, int(out[i, real]))
+                continue
+            d = int(q_lens[i]) - 1
+            if d:
+                had_spec = True
+            else:
+                had_decode = True
+            # acc is bounded by the drafted count in-program; all d+1
+            # written positions advance first, then the rejected tail rolls
+            # back — net advance is the accepted prefix + bonus token
+            self._settle_spec_row(r, d, int(out[i, 0]), out[i])
+        if had_decode:
+            self.stats["decode_steps"] += 1
+        if had_spec:
+            self.stats["spec_rounds"] += 1
+
     def _reserve_for_growth(self, running: List[Request], need: Dict[int, int]) -> List[Request]:
         """Make every running row writable for its next ``need[uid]`` tokens
         (default 1) — page growth plus the pool's copy-on-write barrier for
@@ -500,18 +648,44 @@ class PagedServer:
             idx += 1
         return running
 
-    def _dispatch_rows(self, running: List[Request]):
-        """Bucket-padded (bucket, page_table, lengths) for one dispatch —
-        rows past ``len(running)`` are dead padding (-1 tables / length 0:
-        trash-page semantics make them always safe)."""
-        bucket = min(b for b in self.buckets if b >= len(running))
-        page_table = np.full((bucket, self.pool.max_pages_per_slot), -1, np.int32)
-        lengths = np.zeros(bucket, np.int32)
+    def _dispatch_rows(self, running: List[Request], pad_to: Optional[int] = None):
+        """(rows, page_table, lengths) padded to ``pad_to`` rows (default:
+        the smallest slot bucket covering the set; the ragged step passes
+        its fixed row budget) — rows past ``len(running)`` are dead padding
+        (-1 tables / length 0: trash-page semantics make them always
+        safe)."""
+        rows = pad_to or min(b for b in self.buckets if b >= len(running))
+        page_table = np.full((rows, self.pool.max_pages_per_slot), -1, np.int32)
+        lengths = np.zeros(rows, np.int32)
         rows_pt, rows_len = self.pool.rows([r.slot for r in running])
         n = len(running)
         page_table[:n] = rows_pt
         lengths[:n] = rows_len
-        return bucket, page_table, lengths
+        return rows, page_table, lengths
+
+    def _settle_spec_row(self, req: Request, d: int, acc: int, out_row) -> None:
+        """Post-dispatch accounting for one decode/verify row — advance all
+        ``d + 1`` written positions, roll the rejected tail's pages back,
+        update the speculation stats, emit the accepted prefix + bonus/
+        correction token (stopping at EOS / budget), and republish the
+        prefix. Shared verbatim by the bucketed verify round and the ragged
+        step so the oracle and the default path cannot drift."""
+        self.pool.advance(req.slot, d + 1)
+        self.pool.rollback(req.slot, d - acc)
+        self.stats["spec_drafted"] += d
+        self.stats["spec_accepted"] += acc
+        if d:
+            hist = self.stats["spec_accept_hist"]
+            hist[min(acc, len(hist) - 1)] += 1
+        for tok in out_row[1 : acc + 2]:
+            self._emit(req, int(tok))
+            if req.done:  # EOS / budget inside the accepted run
+                break
+        if self.prefix_cache and not req.done:
+            # post-rollback length is the canonical accepted context
+            self.pool.register_prefix(
+                req.slot, req.context(), int(self.pool.seq_lens[req.slot])
+            )
 
     def _plain_decode_step(self, running: List[Request]) -> None:
         running = self._reserve_for_growth(running, {})
@@ -594,27 +768,11 @@ class PagedServer:
         # the round's single host fetch: [bucket, K+2] = accept count + the
         # greedy token after each prefix
         out = np.asarray(out)  # lint: allow(DS-R005)
-        hist = self.stats["spec_accept_hist"]
         for i, req in enumerate(running):
-            d = int(draft_lens[i])
-            acc = int(out[i, 0])  # bounded by draft_lens in-program
-            # all d+1 written positions first, then the rejected tail rolls
+            # acc (out[i, 0]) is bounded by draft_lens in-program; all d+1
+            # written positions advance first, then the rejected tail rolls
             # back — net advance is the accepted prefix + bonus token
-            self.pool.advance(req.slot, d + 1)
-            self.pool.rollback(req.slot, d - acc)
-            self.stats["spec_drafted"] += d
-            self.stats["spec_accepted"] += acc
-            if d:
-                hist[min(acc, len(hist) - 1)] += 1
-            for tok in out[i, 1 : acc + 2]:
-                self._emit(req, int(tok))
-                if req.done:  # EOS / budget inside the accepted run
-                    break
-            if self.prefix_cache and not req.done:
-                # post-rollback length is the canonical accepted context
-                self.pool.register_prefix(
-                    req.slot, req.context(), int(self.pool.seq_lens[req.slot])
-                )
+            self._settle_spec_row(req, int(draft_lens[i]), int(out[i, 0]), out[i])
 
     # --- bookkeeping ----------------------------------------------------
     def _emit(self, req: Request, token: int) -> None:
@@ -673,7 +831,8 @@ class PagedServer:
         return list(self._finished_log)
 
     def serve_stats(self) -> Dict:
-        """Scheduler counters plus derived speculation observability
+        """Scheduler counters (incl. ``ragged_steps`` — one per unified
+        dispatch on the default path) plus derived speculation observability
         (acceptance rate, mean accepted drafts per round, draft-hit
         histogram), pool occupancy/utilization, prefix-cache counters
         (hit rate, CoW copies, cached pages), and latency SLOs — aggregate

@@ -20,6 +20,14 @@ layout:
   (B = slot bucket, T = K+1 draft-and-bonus slots), which also passes
   per-row ``kv_lens`` so pad draft slots past a row's live prefix are
   masked out of every score.
+* ``ragged_paged_attention`` — the unified entry the one-program ragged
+  serving step dispatches (``decode.py:build_ragged_step``): mixed
+  prefill-chunk / decode / verify rows in one ``[R, W]`` window, driven
+  entirely by per-row ``(kv_len, q_len)`` metadata arrays so the mix
+  never retraces. Pallas kernel on TPU
+  (``decode_attention.ragged_paged_attention``: kv grid walks the page
+  table via scalar prefetch, causal in-window mask, pages past a row's
+  live length skipped), XLA gather fallback elsewhere.
 
 GQA is handled by grouping — queries reshape to ``[B, NKV, G, D]`` and each
 kv head's rows are read once — so no path here (kernel or fallback) ever
@@ -44,6 +52,7 @@ from deepspeed_tpu.ops.transformer.decode_attention import (
     NEG_INF,
     _on_tpu,
     paged_decode_attention as _pallas_paged_decode,
+    ragged_paged_attention as _pallas_ragged_paged,
 )
 
 
@@ -113,6 +122,46 @@ def paged_decode_attention(
     if impl == "xla":
         return paged_decode_attention_xla(q, k_pages, v_pages, page_table, kv_len, scale=scale)
     raise ValueError(f"unknown paged attention impl {impl!r}; expected auto|pallas|xla")
+
+
+def ragged_paged_attention(
+    q: jnp.ndarray,  # [R, W, NH, D] — per-row padded token windows
+    k_pages: jnp.ndarray,  # [NP, NKV, P, D]
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,  # [R, MAXP] int32
+    kv_lens: jnp.ndarray,  # [R] live kv length INCLUDING this step's tokens
+    q_lens: jnp.ndarray,  # [R] real tokens in the window (0 = dead row)
+    scale: Optional[float] = None,
+    impl: str = "auto",
+) -> jnp.ndarray:
+    """Unified mixed-row attention for the one-program ragged serving step
+    (arXiv 2604.15464): every row attends causally over its own pages with
+    per-row ``(kv_len, q_len)`` metadata riding in as arrays — a decode row
+    (q_len 1), a verify row (q_len K+1), and a prefill chunk (q_len C) all
+    take the same code path, so shifting the mix never changes the program.
+    ``impl``: ``auto`` picks the Pallas ragged kernel on TPU and the XLA
+    gather fallback elsewhere; ``pallas`` / ``xla`` force one (``pallas``
+    off-TPU runs in interpret mode — tests only). Rows with
+    ``kv_lens == 0`` return exact zeros; window slots past ``q_lens``
+    return garbage the caller ignores."""
+    if impl == "auto":
+        impl = "pallas" if _on_tpu() else "xla"
+    if impl == "pallas":
+        return _pallas_ragged_paged(
+            q, k_pages, v_pages, page_table, kv_lens, q_lens, scale=scale
+        )
+    if impl != "xla":
+        raise ValueError(f"unknown ragged attention impl {impl!r}; expected auto|pallas|xla")
+    R, W = q.shape[:2]
+    lens = jnp.asarray(kv_lens, jnp.int32)
+    qlens = jnp.asarray(q_lens, jnp.int32)
+    # absolute query positions: the row's write base (kv_len - q_len) plus
+    # the in-window offset — the causal mask then bounds every real slot,
+    # and the kv_lens cap silences pad slots' reads above the live prefix
+    q_positions = (lens - qlens)[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]
+    return paged_prefill_attention(
+        q, k_pages, v_pages, page_table, q_positions, scale=scale, kv_lens=lens
+    )
 
 
 def paged_prefill_attention(

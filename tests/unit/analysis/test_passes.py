@@ -497,6 +497,7 @@ def test_green_spec_verify_programs():
         cfg, params, page_size=8, max_slots=4, prefill_chunk=8,
         attn_impl="xla", dtype=jnp.float32, telemetry=tel,
         spec_decode={"max_draft": 2}, drafter=TwoTokenDrafter(),
+        ragged=False,  # the bucketed oracle's verify programs
     )
     rs = np.random.RandomState(0)
     prompts = [rs.randint(0, 128, (7,)).astype(np.int32) for _ in range(3)]
@@ -545,7 +546,7 @@ def test_green_traffic_serving_programs():
         PagedServer(
             cfg, params, page_size=8, max_slots=4, prefill_chunk=8,
             attn_impl="xla", dtype=jnp.float32, telemetry=tel,
-            prefix_cache=True,
+            prefix_cache=True, ragged=False,  # the bucketed oracle's programs
         ),
         tenants=[TenantSpec(name="a", weight=2.0), TenantSpec(name="b")],
     )
@@ -578,6 +579,89 @@ def test_green_traffic_serving_programs():
     for name, prog in rep["programs"].items():
         assert prog["passes"]["host_transfer"]["ok"], name
         assert prog["passes"]["donation"]["ok"], name
+
+
+# ---------------------------------------------------------------------------
+# green sweep + compile-budget gate: the ragged serving program (ISSUE 8)
+# ---------------------------------------------------------------------------
+def test_green_ragged_serving_program_and_compile_gate():
+    """THE acceptance gate for ragged serving: a full mixed serve (prefill
+    chunks + plain decode + drafted verify rows, the mix shifting across 3
+    waves) compiles ≤ 2 ``paged_*`` programs TOTAL, dispatches exactly one
+    ragged program per scheduler step, never retraces a program after its
+    first compile (3-wave retrace guard), and every compiled ragged
+    program verifies clean under the donation, host-transfer, and
+    dtype-promotion passes."""
+    from deepspeed_tpu.analysis import run_program_passes
+    from deepspeed_tpu.inference.scheduler import (
+        PagedServer,
+        compiled_serving_programs,
+    )
+    from deepspeed_tpu.inference.spec_decode import Drafter
+    from deepspeed_tpu.models import TransformerLM
+    from deepspeed_tpu.models.config import TransformerConfig
+
+    class MixDrafter(Drafter):
+        # per-request spec-K mix: row uid drafts uid % 3 tokens, so rounds
+        # carry 0-, 1-, and 2-draft rows simultaneously
+        def propose(self, uid, context, k):
+            return np.arange(min(k, uid % 3), dtype=np.int32)
+
+    cfg = TransformerConfig(
+        vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+        num_kv_heads=2, max_seq_len=64, norm="rmsnorm", position="rope",
+        activation="swiglu", use_bias=False, tie_embeddings=False,
+        flash_attention=False, dtype="float32",
+    )
+    model = TransformerLM(cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0, cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(0), toks)
+    tel = CompileTelemetry()
+    server = PagedServer(
+        cfg, params, page_size=8, max_slots=4, prefill_chunk=8,
+        attn_impl="xla", dtype=jnp.float32, telemetry=tel,
+        spec_decode={"max_draft": 2}, drafter=MixDrafter(), prefix_cache=True,
+    )
+    assert server.ragged  # the default path is the one under the gate
+    rs = np.random.RandomState(0)
+    # 3 waves of shifting mixes: short prompts (single chunk), long prompts
+    # (multi-chunk, so chunks ride WITH in-flight decoders), varying counts
+    waves = [
+        [rs.randint(0, 128, (int(n),)).astype(np.int32) for n in lens]
+        for lens in ([5, 7], [19, 4, 22, 9], [13])
+    ]
+    compiles_after_wave = []
+    for wave in waves:
+        server.serve(wave, max_new_tokens=6)
+        compiles_after_wave.append(
+            sum(r["compiles"] for r in tel.stats().values())
+        )
+    assert server.stats["spec_rounds"] >= 1, "the mix never drafted"
+    assert server.stats["prefill_chunks"] > len(
+        [p for w in waves for p in w]
+    ), "no multi-chunk prompt: prefill never coexisted with decode"
+    stats = tel.stats()
+    assert all(n.startswith("paged_ragged_") for n in stats), stats.keys()
+    # THE gate: ≤ 2 compiled serving programs for the whole mixed serve
+    assert compiled_serving_programs(stats) <= 2, stats
+    # retrace guard: wave 1 compiled everything (warmup); waves 2 and 3
+    # shifted the prefill/decode/verify mix without a single new trace
+    assert compiles_after_wave[1] == compiles_after_wave[0], compiles_after_wave
+    assert compiles_after_wave[2] == compiles_after_wave[0], compiles_after_wave
+    for name, rec in stats.items():
+        assert rec["compiles"] <= 1, f"{name} recompiled: {rec}"
+    # exactly ONE dispatch per scheduler step
+    assert sum(r["dispatches"] for r in stats.values()) == server.stats["ragged_steps"]
+    # analysis green sweep: donation aliased, no host transfers, no upcasts
+    rep = run_program_passes(tel)
+    t = rep["totals"]
+    assert t["analysis_failures"] == 0 and t["violations"] == 0, rep
+    assert t["donation_verified"] is True
+    for name in rep["programs"]:
+        passes = rep["programs"][name]["passes"]
+        assert passes["host_transfer"]["ok"]
+        assert passes["dtype_promotion"]["ok"]
+        assert passes["donation"]["ok"]
 
 
 # ---------------------------------------------------------------------------

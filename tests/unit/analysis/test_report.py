@@ -125,6 +125,7 @@ def test_green_paged_serving_programs():
     server = PagedServer(
         cfg, params, page_size=8, max_slots=4, prefill_chunk=8,
         attn_impl="xla", dtype=jnp.float32, telemetry=tel,
+        ragged=False,  # the bucketed oracle's decode/prefill programs
     )
     rs = np.random.RandomState(0)
     prompts = [rs.randint(0, 128, (7,)).astype(np.int32) for _ in range(3)]

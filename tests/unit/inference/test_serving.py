@@ -61,6 +61,10 @@ def _dense(cfg, params, prompt, n, eos=None):
 
 
 def _server(cfg, params, **kw):
+    # this suite exercises the BUCKETED per-shape programs (the ragged
+    # path's token-exactness oracle); the ragged default is covered by
+    # test_ragged_serving.py and the engine-surface test below
+    kw.setdefault("ragged", False)
     kw.setdefault("page_size", 8)
     kw.setdefault("max_slots", 4)
     kw.setdefault("prefill_chunk", 8)
@@ -162,8 +166,9 @@ def test_retrace_guard_and_single_dispatch_per_step(model_and_params):
 
 
 def test_engine_serve_and_compile_stats(model_and_params):
-    """The engine-level surface: paged_kv config knobs, serve(), and the
-    inference compile_stats() satellite (forward + decode loop programs)."""
+    """The engine-level surface: paged_kv config knobs, serve() (on the
+    default RAGGED path), and the inference compile_stats() satellite
+    (forward + decode loop programs)."""
     cfg, model, params = model_and_params
     engine = ds.init_inference(
         model,
@@ -177,15 +182,15 @@ def test_engine_serve_and_compile_stats(model_and_params):
     for p, out in zip(prompts, outs):
         np.testing.assert_array_equal(out, _dense(cfg, params, p, 6))
     stats = engine.compile_stats()
-    assert any(k.startswith("paged_decode_") for k in stats)
+    assert any(k.startswith("paged_ragged_") for k in stats)
     sstats = engine.serve_stats()
     assert sstats["finished"] == 3 and sstats["decode_steps"] >= 1
-    # acceptance: exactly one paged_decode dispatch per decode step,
-    # observed through the engine's own compile_stats()
+    # acceptance: exactly ONE ragged dispatch per scheduler step, observed
+    # through the engine's own compile_stats()
     assert sum(
         rec["dispatches"] for name, rec in stats.items()
-        if name.startswith("paged_decode_")
-    ) == sstats["decode_steps"]
+        if name.startswith("paged_ragged_")
+    ) == sstats["ragged_steps"]
     # satellite: the jitted forward and the kv decode loop are instrumented
     toks = jnp.asarray(np.stack([np.resize(prompts[0], 8)]))
     engine(toks)

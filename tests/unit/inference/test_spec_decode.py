@@ -65,6 +65,10 @@ def _dense(cfg, params, prompt, n, eos=None):
 
 
 def _server(cfg, params, **kw):
+    # this suite exercises the BUCKETED verify programs (the ragged path's
+    # token-exactness oracle); ragged speculation is covered by
+    # test_ragged_serving.py and the engine-surface test below
+    kw.setdefault("ragged", False)
     kw.setdefault("page_size", 8)
     kw.setdefault("max_slots", 4)
     kw.setdefault("prefill_chunk", 8)

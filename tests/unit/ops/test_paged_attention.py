@@ -17,6 +17,7 @@ from deepspeed_tpu.ops.transformer.paged_attention import (
     paged_decode_attention,
     paged_decode_attention_xla,
     paged_prefill_attention,
+    ragged_paged_attention,
 )
 
 
@@ -130,6 +131,94 @@ def test_prefill_chunk_matches_causal_reference():
             np.asarray(out[:, t]), ref, rtol=2e-5, atol=2e-5,
             err_msg=f"chunk offset {t}",
         )
+
+
+# --- ragged mixed-row attention (ISSUE 8) -----------------------------------
+def _ragged_fixture(rs, R=3, W=6, NH=4, nkv=2, D=16, P=8, NP=12, maxp=4):
+    """A genuinely mixed window: row 0 decodes (q_len 1), row 1 runs a
+    prefill chunk filling its window (q_len W), row 2 is dead padding."""
+    q = jnp.asarray(rs.randn(R, W, NH, D).astype(np.float32))
+    kp, vp = _rand_pool(rs, NP, nkv, P, D)
+    pt = np.full((R, maxp), -1, np.int32)
+    pt[0, :3] = [3, 7, 1]
+    pt[1, :1] = [5]
+    kv_lens = np.array([18, W, 0], np.int32)  # INCLUDING this step's tokens
+    q_lens = np.array([1, W, 0], np.int32)
+    return q, kp, vp, jnp.asarray(pt), jnp.asarray(kv_lens), jnp.asarray(q_lens)
+
+
+def test_ragged_matches_per_mode_reference():
+    """Each row of a mixed window must equal its single-mode computation:
+    the decode row matches masked decode attention at its length, the
+    chunk row matches the causal per-position reference, and the dead row
+    is exact zeros."""
+    rs = np.random.RandomState(4)
+    q, kp, vp, pt, kv_lens, q_lens = _ragged_fixture(rs)
+    W, D, P = q.shape[1], q.shape[3], kp.shape[2]
+    out = np.asarray(ragged_paged_attention(q, kp, vp, pt, kv_lens, q_lens, impl="xla"))
+    k_lin = _dense_from_pages(kp, pt, P)
+    v_lin = _dense_from_pages(vp, pt, P)
+    scale = 1.0 / np.sqrt(D)
+    # decode row: one token at position kv_len-1 sees the whole prefix
+    ref0 = _ref_decode(np.asarray(q[0:1, 0]), k_lin[0:1], v_lin[0:1],
+                       np.array([18]), scale)
+    np.testing.assert_allclose(out[0:1, 0], ref0, rtol=2e-5, atol=2e-5)
+    # chunk row: causal per position (start 0: kv_len == q_len)
+    for t in range(W):
+        ref1 = _ref_decode(np.asarray(q[1:2, t]), k_lin[1:2], v_lin[1:2],
+                           np.array([t + 1]), scale)
+        np.testing.assert_allclose(out[1:2, t], ref1, rtol=2e-5, atol=2e-5,
+                                   err_msg=f"chunk offset {t}")
+    assert (out[2] == 0).all()  # dead row: exact zeros
+
+
+def test_ragged_xla_matches_pallas_interpret():
+    """The Pallas ragged kernel (scalar-prefetched page table + per-row
+    (kv_len, q_len) metadata) agrees with the XLA gather fallback on every
+    LIVE window slot; dead rows are zeros in both."""
+    rs = np.random.RandomState(5)
+    q, kp, vp, pt, kv_lens, q_lens = _ragged_fixture(rs)
+    out_x = np.asarray(ragged_paged_attention(q, kp, vp, pt, kv_lens, q_lens, impl="xla"))
+    out_p = np.asarray(ragged_paged_attention(q, kp, vp, pt, kv_lens, q_lens, impl="pallas"))
+    for r, ql in enumerate(np.asarray(q_lens)):
+        np.testing.assert_allclose(
+            out_x[r, :ql], out_p[r, :ql], rtol=2e-5, atol=2e-5, err_msg=f"row {r}"
+        )
+    assert (out_p[2] == 0).all()
+
+
+def test_ragged_mid_sequence_verify_row():
+    """A verify-shaped row (q_len 3 starting mid-sequence) must score each
+    slot causally against prefix + earlier slots — the accepted-prefix
+    computation depends on it."""
+    rs = np.random.RandomState(6)
+    R, W, NH, nkv, D, P, NP, maxp = 1, 4, 4, 2, 8, 4, 8, 4
+    q = jnp.asarray(rs.randn(R, W, NH, D).astype(np.float32))
+    kp, vp = _rand_pool(rs, NP, nkv, P, D)
+    pt = np.array([[2, 5, 1, -1]], np.int32)
+    start, ql = 5, 3  # tokens at positions 5, 6, 7; slot 3 is pad garbage
+    kv_lens = np.array([start + ql], np.int32)
+    q_lens = np.array([ql], np.int32)
+    out = np.asarray(ragged_paged_attention(
+        q, kp, vp, jnp.asarray(pt), jnp.asarray(kv_lens), jnp.asarray(q_lens),
+        impl="xla",
+    ))
+    k_lin = _dense_from_pages(kp, pt, P)
+    v_lin = _dense_from_pages(vp, pt, P)
+    for t in range(ql):
+        ref = _ref_decode(np.asarray(q[:, t]), k_lin, v_lin,
+                          np.array([start + t + 1]), 1.0 / np.sqrt(D))
+        np.testing.assert_allclose(out[:, t], ref, rtol=2e-5, atol=2e-5,
+                                   err_msg=f"verify slot {t}")
+    # garbage k/v in the tabled page past the live length (table slot 2 =
+    # positions 8..11, all >= kv_len 8) never leak in
+    kp2 = kp.at[1].set(1e6)
+    vp2 = vp.at[1].set(-1e6)
+    out2 = np.asarray(ragged_paged_attention(
+        q, kp2, vp2, jnp.asarray(pt), jnp.asarray(kv_lens), jnp.asarray(q_lens),
+        impl="xla",
+    ))
+    np.testing.assert_allclose(out[:, :ql], out2[:, :ql], rtol=1e-6)
 
 
 def test_gqa_grouped_equals_repeat_expansion():

@@ -29,6 +29,12 @@
 # randomized pool partition invariant, SLA no-starvation replay smoke
 # (2 tenants, shared prefix, flood-vs-trickle on a virtual clock),
 # admission control, DS-R007 lint, traffic green sweep.
+# +ragged serving 2026-08-04 (test_ragged_serving.py + extended
+# test_paged_attention.py + analysis compile gate): ragged-vs-bucketed
+# byte-identical streams across admission/preemption/prefix/spec-K-mix/
+# EOS, ≤2-compiled-programs + 1-dispatch-per-step + 3-wave retrace
+# guards, ragged attention kernel parity (XLA fallback + Pallas
+# interpret), ragged program green sweep.
 cd "$(dirname "$0")/.." || exit 1
 sh tools/lint.sh || exit 1
 exec python -m pytest -q \
@@ -43,6 +49,7 @@ exec python -m pytest -q \
   tests/unit/runtime/zero \
   tests/unit/inference/test_kv_pool.py \
   tests/unit/inference/test_serving.py \
+  tests/unit/inference/test_ragged_serving.py \
   tests/unit/inference/test_spec_decode.py \
   tests/unit/inference/test_traffic.py \
   tests/unit/ops/test_paged_attention.py \
