@@ -32,6 +32,15 @@ Rules (each one traces back to a real incident in PERF.md / PR history):
   a hand-rolled blocking collective at the use point serializes the loop
   schedule the pipeline exists to overlap. Deliberate non-parameter or
   non-pipelined collectives carry a pragma.
+* **DS-R008 non-atomic-persistence-write** — ``open(path, "w"/"wb")`` in a
+  checkpoint / journal / bench-record code path (path or enclosing
+  function named like one): a ``kill -9`` mid-write leaves a torn file
+  that the ``latest`` marker, the known-good store, or a journal replay
+  may then trust. Persist via write-to-temp → fsync → rename
+  (``runtime/checkpoint_engine/atomic.py``); staged/temp writes (a
+  tmp/staging/partial identifier in the path expression) are the
+  sanctioned pattern and exempt. Append-mode opens are fine — append-only
+  logs tolerate torn tails by design (CRC-gated replay).
 * **DS-R007 pool-internals-mutated-outside-pool** — writing ``PagePool``
   internals (page tables, seq lens, free lists, refcounts, the prefix
   index, or the device cache) from outside the pool's own methods: the
@@ -64,8 +73,17 @@ RULES = {
     "DS-R005": "host transfer inside the serving step loop (hot path)",
     "DS-R006": "blocking collective on parameters inside a scanned layer body",
     "DS-R007": "PagePool internals mutated outside the pool's own methods",
+    "DS-R008": "non-atomic persistence write (open 'w' without temp+rename) in a checkpoint/journal/bench path",
 }
 _WARN_ONLY = {"DS-R003", "DS-R004"}
+
+# DS-R008 scope: files (or enclosing functions) that persist state other
+# code will later trust — checkpoint layouts, journals, bench records.
+_PERSIST_PATH = re.compile(r"(checkpoint|journal|bench)", re.IGNORECASE)
+_PERSIST_FN = re.compile(r"(checkpoint|journal|known_good|latest|marker)", re.IGNORECASE)
+# the sanctioned atomic pattern: writes into a temp/staging sibling that a
+# rename later commits
+_TMPISH = re.compile(r"(tmp|temp|staging|partial|scratch)", re.IGNORECASE)
 
 # DS-R007 scope: the pool state only pool methods may write. Distinctive
 # names flag on ANY receiver; the generic ones (cache/_free/_owned/seq_lens
@@ -456,6 +474,51 @@ def lint_source(src: str, path: str = "<string>") -> List[LintFinding]:
             _scan_r007(child, in_pool)
 
     _scan_r007(tree, False)
+
+    # ---- DS-R008: non-atomic persistence writes -----------------------
+    file_in_scope = bool(_PERSIST_PATH.search(path.replace(os.sep, "/")))
+
+    def _write_mode(call: ast.Call) -> Optional[str]:
+        mode = None
+        if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant):
+            mode = call.args[1].value
+        for kw in call.keywords:
+            if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                mode = kw.value.value
+        if isinstance(mode, str) and "w" in mode:
+            return mode
+        return None
+
+    def _tmpish_path(arg: ast.AST) -> bool:
+        for n in ast.walk(arg):
+            if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                if _TMPISH.search(n.value):
+                    return True
+        return any(_TMPISH.search(i) for i in _identifiers(arg))
+
+    def _scan_r008(node, fn_in_scope):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn_in_scope = fn_in_scope or bool(_PERSIST_FN.search(node.name))
+        if (
+            isinstance(node, ast.Call)
+            and _dotted(node.func) == "open"
+            and (file_in_scope or fn_in_scope)
+            and node.args
+        ):
+            mode = _write_mode(node)
+            if mode is not None and not _tmpish_path(node.args[0]):
+                add(
+                    node.lineno,
+                    "DS-R008",
+                    f"open(..., {mode!r}) in a persistence path: a kill "
+                    "mid-write leaves a torn file later readers trust — "
+                    "write to a temp sibling and rename "
+                    "(runtime/checkpoint_engine/atomic.py)",
+                )
+        for child in ast.iter_child_nodes(node):
+            _scan_r008(child, fn_in_scope)
+
+    _scan_r008(tree, False)
 
     # ---- DS-R004: jit call sites without donation ---------------------
     for call in collector.jit_calls:

@@ -106,8 +106,9 @@ def convert_to_universal(ckpt_dir: str, out_dir: str, tag: Optional[str] = None)
         PARAM_SHAPE_KEY: {k: list(v.shape) for k, v in fp32.items()},
         "source": os.path.abspath(ckpt_dir),
     }
-    with open(os.path.join(out_dir, META_FILE), "w") as f:
-        json.dump(meta, f, indent=2)
+    from deepspeed_tpu.runtime.checkpoint_engine.atomic import atomic_write_text
+
+    atomic_write_text(os.path.join(out_dir, META_FILE), json.dumps(meta, indent=2))
     return out_file
 
 

@@ -132,8 +132,9 @@ def export_reference_checkpoint(
             get_zero_ckpt_name_for_rank(path, dp, 0),
         )
 
-    with open(os.path.join(save_dir, "latest"), "w") as f:
-        f.write(tag)
+    from deepspeed_tpu.runtime.checkpoint_engine.atomic import write_latest_marker
+
+    write_latest_marker(save_dir, tag)
     log_dist(
         f"exported reference-layout checkpoint: {path} "
         f"({len(names)} tensors, dp_shards={dp_shards})",

@@ -31,6 +31,8 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from deepspeed_tpu.runtime.checkpoint_engine.atomic import write_latest_marker
+
 from deepspeed_tpu.checkpoint.reference_ingest import (
     _resolve_tag_dir,
     _to_numpy,
@@ -535,8 +537,7 @@ def export_megatron_checkpoint(
     path = os.path.join(save_dir, tag)
     if dist.get_rank() == 0:
         write_reference_layout(canon, path, tp=tp, pp=pp, dp=dp)
-        with open(os.path.join(save_dir, "latest"), "w") as f:
-            f.write(tag)
+        write_latest_marker(save_dir, tag)
     dist.barrier(name="export_megatron_checkpoint")
     log_dist(f"exported megatron-layout checkpoint: {path} (tp={tp} pp={pp} dp={dp})", ranks=[0])
     return path
@@ -560,8 +561,7 @@ def reshape_checkpoint_3d(
     out = dst_dir if tag is None else os.path.join(dst_dir, tag)
     write_reference_layout(canon, out, tp=tp, pp=pp, dp=dp)
     if tag is not None:
-        with open(os.path.join(dst_dir, "latest"), "w") as f:
-            f.write(tag)
+        write_latest_marker(dst_dir, tag)
     log_dist(
         f"reshaped checkpoint {src_desc} -> {Model3DDescriptor(tp, pp, dp)}: {out}",
         ranks=[0],

@@ -137,6 +137,26 @@ class TrafficConfig(DeepSpeedConfigModel):
     tenants: List[TenantConfig] = Field(default_factory=list)
 
 
+class JournalConfig(DeepSpeedConfigModel):
+    """Serving crash-recovery journal (``inference/journal.py``).
+
+    With ``enabled`` (and a ``dir``) every admitted request and emitted
+    token is appended to an on-disk journal — durable once per scheduler
+    step — and building the server on a directory that already holds
+    records REPLAYS it first: finished results are restored, live requests
+    re-queue with their journaled tokens pre-seeded, and every stream
+    resumes byte-identically from its last emitted token (re-prefill rides
+    the prefix cache, so shared prompts pay nearly nothing). Segments seal
+    atomically at ``segment_bytes``; ``fsync=False`` trades durability of
+    the last step for write latency (replay still never reads a torn
+    record — CRCs gate every line)."""
+
+    enabled: bool = False
+    dir: Optional[str] = None
+    segment_bytes: int = 1 << 20
+    fsync: bool = True
+
+
 class SpecDecodeConfig(DeepSpeedConfigModel):
     """Speculative-decoding knobs for paged serving (``engine.serve()``).
 
@@ -170,6 +190,7 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     paged_kv: PagedKVConfig = Field(default_factory=PagedKVConfig)
     spec_decode: SpecDecodeConfig = Field(default_factory=SpecDecodeConfig)
     traffic: TrafficConfig = Field(default_factory=TrafficConfig)
+    journal: JournalConfig = Field(default_factory=JournalConfig)
     analysis: AnalysisConfig = Field(default_factory=AnalysisConfig)
     checkpoint: Optional[Any] = None
     base_dir: str = ""

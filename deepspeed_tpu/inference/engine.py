@@ -540,6 +540,23 @@ class InferenceEngine:
         pcfg = self._config.paged_kv
         if not pcfg.enabled:
             raise ValueError("paged serving is disabled (inference config paged_kv.enabled)")
+        # crash-recovery journal (inference.journal): replay BEFORE the new
+        # writer opens its segment, then hand the replayed state to the
+        # fresh server — a restart resumes every journaled stream
+        # byte-identically from its last emitted token
+        journal = None
+        recovered_states = None
+        next_uid = 0
+        jcfg = self._config.journal
+        if jcfg.enabled:
+            from deepspeed_tpu.inference.journal import RequestJournal
+
+            if not jcfg.dir:
+                raise ValueError("inference.journal.enabled requires journal.dir")
+            recovered_states, next_uid = RequestJournal.replay(jcfg.dir)
+            journal = RequestJournal(
+                jcfg.dir, segment_bytes=jcfg.segment_bytes, fsync=jcfg.fsync
+            )
         server = PagedServer(
             self._ds_config,
             self._params,
@@ -555,7 +572,10 @@ class InferenceEngine:
             spec_decode=self._config.spec_decode,
             prefix_cache=pcfg.prefix_cache,
             ragged=pcfg.ragged,
+            journal=journal,
         )
+        if recovered_states:
+            server.recover(recovered_states, next_uid)
         tcfg = self._config.traffic
         if tcfg.enabled:
             # multi-tenant SLA layer (inference/traffic.py): weighted-deficit

@@ -1,0 +1,333 @@
+"""Append-only request journal for serving crash recovery.
+
+A ``PagedServer`` crash today drops every live stream. The journal makes a
+restart a *resume*: every admitted request and every emitted token is
+appended to an on-disk log, and on restart the server replays it — each
+unfinished request is re-submitted with its journaled emissions pre-seeded,
+so its re-prefill (nearly free under prefix caching for shared prompts)
+re-derives the exact greedy continuation and the stream resumes
+**byte-identically** from its last emitted token. This is the same
+machinery that makes recompute-preemption invisible, driven from disk.
+
+Layout: numbered segments under the journal directory.
+
+* the ACTIVE segment (``seg_<n>.open``) takes appends; records are
+  buffered in-process and flushed (+``fsync``) once per scheduler step via
+  ``sync()`` — one durability point per dispatch, not per token;
+* at ``segment_bytes`` the active segment is SEALED: fsynced, then
+  atomically renamed to ``seg_<n>.jrnl``. Sealed segments are immutable
+  and fully valid by construction;
+* each record is one line — ``<crc32:08x> <compact-json>`` — so torn tails
+  are *detectable*: replay accepts a torn record only at the very tail of
+  the newest segment (the instant the crash happened) and raises
+  :class:`JournalCorruptError` anywhere else (a bad record in a sealed
+  segment, or garbage with valid records after it, is corruption, not a
+  crash artifact).
+
+Record types: ``s`` submit (uid, prompt, budget, eos, tenant, and — for
+recovery re-submits — the tokens already emitted), ``e`` emit (uid, token),
+``f`` finish (uid). A later ``s`` for the same uid replaces the earlier
+state, which is how recovery compacts: the restarted server journals one
+seeded submit per live request into a fresh segment, so the chain stays
+replayable from any point without rewriting history.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import zlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.runtime.checkpoint_engine.atomic import fsync_dir
+from deepspeed_tpu.utils import chaos
+from deepspeed_tpu.utils.logging import logger
+
+_SEG_SEALED = re.compile(r"^seg_(\d{6})\.jrnl$")
+_SEG_OPEN = re.compile(r"^seg_(\d{6})\.open$")
+
+
+class JournalCorruptError(RuntimeError):
+    """The journal is damaged beyond what a crash can explain: a sealed
+    segment fails its CRC, or valid records follow a broken one."""
+
+
+@dataclass
+class JournaledRequest:
+    """One request's replayed state."""
+
+    uid: int
+    prompt: np.ndarray
+    max_new_tokens: int
+    eos_token_id: Optional[int]
+    tenant: str
+    generated: List[int] = field(default_factory=list)
+    finished: bool = False
+
+    @property
+    def done(self) -> bool:
+        """Finished explicitly, or implicitly (the crash ate the finish
+        record but the journaled emissions already hit the budget/EOS)."""
+        if self.finished:
+            return True
+        if len(self.generated) >= self.max_new_tokens:
+            return True
+        return (
+            self.eos_token_id is not None
+            and bool(self.generated)
+            and self.generated[-1] == self.eos_token_id
+        )
+
+
+def _encode(rec: dict) -> bytes:
+    payload = json.dumps(rec, separators=(",", ":"), sort_keys=True)
+    crc = zlib.crc32(payload.encode("utf-8")) & 0xFFFFFFFF
+    return f"{crc:08x} {payload}\n".encode("utf-8")
+
+
+def _decode(line: bytes) -> Optional[dict]:
+    """The record, or None when the line is torn/corrupt."""
+    try:
+        text = line.decode("utf-8")
+        crc_hex, payload = text.split(" ", 1)
+        payload = payload.rstrip("\n")
+        if len(crc_hex) != 8:
+            return None
+        if int(crc_hex, 16) != (zlib.crc32(payload.encode("utf-8")) & 0xFFFFFFFF):
+            return None
+        return json.loads(payload)
+    except (ValueError, UnicodeDecodeError):
+        return None
+
+
+class RequestJournal:
+    """Writer half. Construct one per live server; ``replay()`` (static)
+    reads a directory without touching it."""
+
+    def __init__(self, directory: str, segment_bytes: int = 1 << 20, fsync: bool = True):
+        self.dir = os.path.abspath(directory)
+        self.segment_bytes = int(segment_bytes)
+        self.fsync = bool(fsync)
+        os.makedirs(self.dir, exist_ok=True)
+        self._seg_index = self._next_segment_index()
+        # retirement boundary: everything below the index this writer
+        # STARTED at predates this server's lifetime (the compaction may
+        # itself span/seal several segments at or above it — those must
+        # survive retirement)
+        self._first_seg_index = self._seg_index
+        self._fh = None
+        self._buffer: List[bytes] = []
+        self.records_written = 0
+        self.segments_sealed = 0
+
+    # --- writing ---------------------------------------------------------
+    def append_submit(
+        self,
+        uid: int,
+        prompt: np.ndarray,
+        max_new_tokens: int,
+        eos_token_id: Optional[int],
+        tenant: str,
+        generated: Optional[List[int]] = None,
+    ) -> None:
+        rec = {
+            "t": "s",
+            "uid": int(uid),
+            "prompt": np.asarray(prompt, np.int32).tolist(),
+            "max": int(max_new_tokens),
+            "eos": None if eos_token_id is None else int(eos_token_id),
+            "tenant": str(tenant),
+        }
+        if generated:
+            rec["gen"] = [int(t) for t in generated]
+        self._buffer.append(_encode(rec))
+
+    def append_emit(self, uid: int, token: int) -> None:
+        self._buffer.append(_encode({"t": "e", "uid": int(uid), "tok": int(token)}))
+
+    def append_finish(self, uid: int) -> None:
+        self._buffer.append(_encode({"t": "f", "uid": int(uid)}))
+
+    def sync(self) -> None:
+        """Flush buffered records to the active segment and make them
+        durable — called once per scheduler step. Rotates (seals) the
+        segment past ``segment_bytes``."""
+        if not self._buffer:
+            return
+        fh = self._ensure_open()
+        data = b"".join(self._buffer)
+        self.records_written += len(self._buffer)
+        self._buffer.clear()
+        fh.write(data)
+        fh.flush()
+        if self.fsync:
+            os.fsync(fh.fileno())
+        chaos.point("journal.append", path=fh.name)
+        if fh.tell() >= self.segment_bytes:
+            self._seal()
+
+    def close(self) -> None:
+        self.sync()
+        if self._fh is not None:
+            self._seal()
+
+    def retire_older_segments(self) -> int:
+        """Delete every segment from BEFORE this writer's lifetime. Call
+        ONLY after a full compaction has been synced through this writer
+        (recovery re-journals every live request as a seeded submit AND
+        every finished result, so the pre-restart segments are fully
+        superseded) — this is what bounds journal growth across repeated
+        crash/recover cycles. The boundary is the index the writer STARTED
+        at, so a compaction large enough to seal its own segment(s) is
+        never retired with the garbage. Returns the number removed."""
+        removed = 0
+        for path in self.segments(self.dir):
+            name = os.path.basename(path)
+            m = _SEG_SEALED.match(name) or _SEG_OPEN.match(name)
+            if m and int(m.group(1)) < self._first_seg_index:
+                os.remove(path)
+                removed += 1
+        if removed:
+            fsync_dir(self.dir)
+        return removed
+
+    # --- internals -------------------------------------------------------
+    def _open_path(self) -> str:
+        return os.path.join(self.dir, f"seg_{self._seg_index:06d}.open")
+
+    def _ensure_open(self):
+        if self._fh is None:
+            self._fh = open(self._open_path(), "ab")
+        return self._fh
+
+    def _seal(self) -> None:
+        """Atomically promote the active segment to an immutable sealed
+        one. The data is fsynced here UNCONDITIONALLY (one fsync per
+        segment, even under ``fsync=False``): a sealed segment claims
+        full validity, and replay treats CRC damage inside one as
+        corruption — so its bytes must actually be on disk before the
+        rename makes that claim."""
+        fh, self._fh = self._fh, None
+        path = fh.name
+        try:
+            os.fsync(fh.fileno())
+        except OSError:
+            pass
+        fh.close()
+        sealed = os.path.join(self.dir, f"seg_{self._seg_index:06d}.jrnl")
+        os.replace(path, sealed)
+        fsync_dir(self.dir)
+        self._seg_index += 1
+        self.segments_sealed += 1
+
+    def _next_segment_index(self) -> int:
+        idx = -1
+        for name in os.listdir(self.dir):
+            m = _SEG_SEALED.match(name) or _SEG_OPEN.match(name)
+            if m:
+                idx = max(idx, int(m.group(1)))
+        return idx + 1
+
+    # --- replay ----------------------------------------------------------
+    @staticmethod
+    def segments(directory: str) -> List[str]:
+        """All segment paths in append order (sealed and open interleave by
+        index; an index with both is the impossible case a crash during
+        seal cannot produce — ``os.replace`` is atomic — and is rejected)."""
+        directory = os.path.abspath(directory)
+        if not os.path.isdir(directory):
+            return []
+        by_index: Dict[int, str] = {}
+        for name in sorted(os.listdir(directory)):
+            m = _SEG_SEALED.match(name) or _SEG_OPEN.match(name)
+            if not m:
+                continue
+            idx = int(m.group(1))
+            if idx in by_index:
+                raise JournalCorruptError(
+                    f"journal {directory}: segment {idx} exists both sealed "
+                    f"and open ({by_index[idx]} vs {name})"
+                )
+            by_index[idx] = os.path.join(directory, name)
+        return [by_index[i] for i in sorted(by_index)]
+
+    @staticmethod
+    def replay(directory: str) -> Tuple[Dict[int, JournaledRequest], int]:
+        """Rebuild request state from the journal: ``(states, next_uid)``.
+
+        Tolerates exactly the damage crashes can cause — torn TAILS of
+        unsealed (``.open``) segments (dropped, with a log line; repeated
+        crash/recover cycles leave one per crash). Anything else raises
+        :class:`JournalCorruptError`."""
+        states: Dict[int, JournaledRequest] = {}
+        next_uid = 0
+        seg_paths = RequestJournal.segments(directory)
+        for path in seg_paths:
+            sealed = path.endswith(".jrnl")
+            with open(path, "rb") as f:
+                lines = f.readlines()
+            bad_at = None
+            records = []
+            for li, line in enumerate(lines):
+                rec = _decode(line)
+                if rec is None:
+                    bad_at = li
+                    break
+                records.append(rec)
+            if bad_at is not None:
+                # a torn TAIL of any UNSEALED segment is a crash artifact:
+                # each crash leaves its .open segment torn in place and the
+                # restarted writer opens the next index, so several torn
+                # .open tails can legitimately coexist after repeated
+                # crashes. Sealed segments are immutable-by-construction and
+                # valid records after a broken one cannot come from a tear.
+                torn_tail = (
+                    not sealed
+                    and all(_decode(l) is None for l in lines[bad_at:])
+                )
+                if not torn_tail:
+                    raise JournalCorruptError(
+                        f"journal segment {path}: record {bad_at} fails its "
+                        "CRC"
+                        + (
+                            " inside a sealed segment"
+                            if sealed
+                            else " with valid records after it"
+                        )
+                        + " — this is corruption, not a torn crash tail"
+                    )
+                dropped = len(lines) - bad_at
+                logger.warning(
+                    f"journal {path}: dropping {dropped} torn tail record(s) "
+                    "(crash mid-append)"
+                )
+            for rec in records:
+                uid = int(rec["uid"])
+                next_uid = max(next_uid, uid + 1)
+                if rec["t"] == "s":
+                    states[uid] = JournaledRequest(
+                        uid=uid,
+                        prompt=np.asarray(rec["prompt"], np.int32),
+                        max_new_tokens=int(rec["max"]),
+                        eos_token_id=rec.get("eos"),
+                        tenant=rec.get("tenant", "default"),
+                        generated=[int(t) for t in rec.get("gen", [])],
+                    )
+                elif rec["t"] == "e":
+                    if uid in states:
+                        states[uid].generated.append(int(rec["tok"]))
+                elif rec["t"] == "f":
+                    if uid in states:
+                        states[uid].finished = True
+        return states, next_uid
+
+    @staticmethod
+    def has_records(directory: str) -> bool:
+        try:
+            return bool(RequestJournal.segments(directory))
+        except JournalCorruptError:
+            return True

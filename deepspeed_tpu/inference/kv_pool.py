@@ -516,6 +516,59 @@ class PagePool:
         return n
 
     # --- maintenance ----------------------------------------------------
+    def integrity_check(self) -> None:
+        """Verify the pool partition invariant: every allocatable page is
+        exactly one of {free, cached, referenced}, refcounts equal the
+        number of table references, cached pages are indexed, and every
+        slot's live length fits its owned pages. Raises ``RuntimeError``
+        naming the first violation. Used after crash recovery (the rebuilt
+        pool must be internally consistent before serving resumes) and by
+        the randomized soak tests."""
+        refs: dict = {}
+        for s in range(self.max_slots):
+            owned = int(self._owned[s])
+            if self.pages_for(int(self.seq_lens[s])) > owned:
+                raise RuntimeError(
+                    f"pool integrity: slot {s} holds {int(self.seq_lens[s])} "
+                    f"tokens but only {owned} pages"
+                )
+            for i in range(owned):
+                p = int(self.page_table[s, i])
+                if p <= TRASH_PAGE or p >= self.num_pages:
+                    raise RuntimeError(
+                        f"pool integrity: slot {s} table entry {i} is {p}"
+                    )
+                refs[p] = refs.get(p, 0) + 1
+        free = set(self._free)
+        cached = set(int(p) for p in self._cached)
+        referenced = set(refs)
+        for name_a, set_a, name_b, set_b in (
+            ("free", free, "cached", cached),
+            ("free", free, "referenced", referenced),
+            ("cached", cached, "referenced", referenced),
+        ):
+            overlap = set_a & set_b
+            if overlap:
+                raise RuntimeError(
+                    f"pool integrity: page {min(overlap)} is both {name_a} "
+                    f"and {name_b}"
+                )
+        allocatable = set(range(TRASH_PAGE + 1, self.num_pages))
+        missing = allocatable - free - cached - referenced
+        if missing:
+            raise RuntimeError(f"pool integrity: page {min(missing)} leaked")
+        for p, n in refs.items():
+            if int(self._refcount[p]) != n:
+                raise RuntimeError(
+                    f"pool integrity: page {p} refcount {int(self._refcount[p])} "
+                    f"but {n} table reference(s)"
+                )
+        for p in cached:
+            if p not in self._page_hash:
+                raise RuntimeError(
+                    f"pool integrity: cached page {p} is not in the prefix index"
+                )
+
     def defrag(self) -> int:
         """Compact live pages into the lowest ids (one device gather per
         K/V), rewriting tables, refcounts, and the prefix index, and

@@ -121,9 +121,10 @@ def save_mp_checkpoint(
         "shard_dims": shard_dims,
         "dtypes": dtypes,
     }
+    from deepspeed_tpu.runtime.checkpoint_engine.atomic import atomic_write_text
+
     mpath = os.path.join(save_path, MANIFEST_NAME)
-    with open(mpath, "w") as f:
-        json.dump(manifest, f, indent=2)
+    atomic_write_text(mpath, json.dumps(manifest, indent=2))
     return mpath
 
 

@@ -71,9 +71,11 @@ from deepspeed_tpu.inference.decode import (
     build_paged_verify_step,
     build_ragged_step,
 )
+from deepspeed_tpu.inference.journal import JournaledRequest, RequestJournal
 from deepspeed_tpu.inference.kv_pool import PagePool
 from deepspeed_tpu.inference.spec_decode import Drafter, NGramDrafter
 from deepspeed_tpu.models.config import TransformerConfig
+from deepspeed_tpu.utils import chaos
 
 
 def _spec_knob(spec, name, default):
@@ -215,6 +217,7 @@ class PagedServer:
         policy: Optional[SchedulingPolicy] = None,
         clock=None,
         ragged: bool = True,
+        journal: Optional[RequestJournal] = None,
     ):
         self.cfg = cfg
         self.params = params
@@ -229,6 +232,11 @@ class PagedServer:
         # token-exactness oracle.
         self.ragged = bool(ragged)
         self.policy = policy or YoungestFirstPolicy()
+        # crash-recovery journal (inference/journal.py): admissions and
+        # emitted tokens are appended per event and made durable ONCE per
+        # scheduler step (journal.sync() at the end of step()); restart
+        # replays it via recover() and every stream resumes byte-identically
+        self.journal = journal
         # injectable clock: TTFT/TPOT stamps and the load harness's virtual
         # time both read it (default: wall)
         self.clock = clock or time.perf_counter
@@ -300,6 +308,7 @@ class PagedServer:
             "admitted": 0,
             "preempted": 0,
             "finished": 0,
+            "recovered": 0,  # live requests rebuilt from the journal
             "prefix_cached_tokens": 0,  # context tokens attached, not prefilled
             "prefill_chunks": 0,
             # ragged mode: every scheduler step is ONE ragged dispatch;
@@ -370,7 +379,73 @@ class PagedServer:
                     t_submit=self.clock())
         )
         self._tenant(tenant)["submitted"] += 1
+        if self.journal is not None:
+            self.journal.append_submit(
+                uid, prompt, int(max_new_tokens), eos_token_id, tenant
+            )
+            # admissions are durable at submit time, not at the next step:
+            # a request accepted then crashed-on must survive the restart
+            self.journal.sync()
         return uid
+
+    def recover(self, states: Dict[int, "JournaledRequest"], next_uid: int = 0) -> int:
+        """Rebuild the server from replayed journal state (a restart after
+        a crash). Finished requests land directly in the results map (their
+        output is fully journaled); every live request is re-queued with
+        its journaled emissions pre-seeded, so its re-admission prefills
+        ``prompt + generated`` on the cold chunk grid — the exact machinery
+        that makes recompute-preemption invisible — and the stream resumes
+        **byte-identically** from its last emitted token. Prefix caching
+        (when on) makes re-prefill of shared prompts nearly free. Every
+        replayed request — live ones as seeded submit records, finished
+        ones as seeded submit+finish — is re-journaled into the fresh
+        segment, which then alone replays to the same state, so the
+        superseded pre-crash segments are retired (journal growth stays
+        bounded across crash/recover cycles). Returns the number of live
+        requests recovered."""
+        recovered = 0
+        for uid in sorted(states):
+            st = states[uid]
+            if st.done:
+                out = np.concatenate(
+                    [np.asarray(st.prompt, np.int32),
+                     np.asarray(st.generated, np.int32)]
+                )
+                self._results[uid] = out
+                if self.journal is not None:
+                    # finished results ride the compacted segment too, so
+                    # the pre-crash segments become fully superseded and
+                    # retire_older_segments below can drop them
+                    self.journal.append_submit(
+                        uid, st.prompt, st.max_new_tokens, st.eos_token_id,
+                        st.tenant, generated=st.generated,
+                    )
+                    self.journal.append_finish(uid)
+                continue
+            req = Request(
+                uid=uid, prompt=np.asarray(st.prompt, np.int32),
+                max_new_tokens=int(st.max_new_tokens),
+                eos_token_id=st.eos_token_id, tenant=st.tenant,
+                generated=[int(t) for t in st.generated],
+                t_submit=self.clock(),
+            )
+            self._queue.append(req)
+            self._tenant(st.tenant)["submitted"] += 1
+            if self.journal is not None:
+                self.journal.append_submit(
+                    uid, st.prompt, st.max_new_tokens, st.eos_token_id,
+                    st.tenant, generated=st.generated,
+                )
+            recovered += 1
+        self._next_uid = max(self._next_uid, int(next_uid))
+        self.stats["recovered"] += recovered
+        if self.journal is not None:
+            # the compaction (seeded submits + finished results) is durable
+            # before the superseded pre-crash segments are dropped — this
+            # bounds journal growth across repeated crash/recover cycles
+            self.journal.sync()
+            self.journal.retire_older_segments()
+        return recovered
 
     def has_work(self) -> bool:
         return bool(self._queue or self._active)
@@ -396,6 +471,12 @@ class PagedServer:
         else:
             self._prefill_step()
             self._decode_step()
+        # the round's device work and emissions happened; the chaos point
+        # models dying BEFORE the journal flush — the un-synced tokens are
+        # re-derived identically on recovery (greedy re-prefill)
+        chaos.point("serve.mid_step")
+        if self.journal is not None:
+            self.journal.sync()
 
     def run(self) -> Dict[int, np.ndarray]:
         while self.has_work():
@@ -783,6 +864,8 @@ class PagedServer:
             req.t_first = self.clock()
         req.generated.append(token)
         req.pending = token
+        if self.journal is not None:
+            self.journal.append_emit(req.uid, token)
         self._tenant(req.tenant)["tokens"] += 1
         self.policy.on_emit(req, self)
         if (
@@ -807,6 +890,8 @@ class PagedServer:
             tpot_ms = (req.t_finish - req.t_first) * 1e3 / (len(req.generated) - 1)
             ts["tpot_ms"].append(tpot_ms)
         self._finished_log.append((req.tenant, ttft_ms, tpot_ms, len(req.generated)))
+        if self.journal is not None:
+            self.journal.append_finish(req.uid)
         self.policy.on_finish(req, self)
         if self.drafter is not None:
             self.drafter.drop(req.uid)

@@ -6,18 +6,41 @@ TPU-native counterpart of the reference's ``TorchCheckpointEngine``
 shards, which is the reference's per-rank ``zero_pp_rank_*`` file scheme done
 by the storage layer instead of by hand. Non-array metadata rides a side
 pickle/JSON.
+
+Atomicity (``atomic.py``): ``save()`` stages the whole checkpoint under a
+``<path>.staging`` sibling (DETERMINISTIC across ranks — a multi-process
+orbax save is collective, every rank must target one shared dir; in-process
+same-tag concurrency is serialized by the engine) — arrays, then metadata,
+then a ``_COMPLETE`` sentinel — and ``commit()`` renames it into place in
+one atomic directory rename. A ``kill -9`` at any instant therefore leaves
+either the previous committed checkpoint or the new one, never a torn mix;
+staging garbage from killed saves is reclaimed on the next save of the same
+tag. ``load()`` raises :class:`CheckpointCorruptError` with a named cause on
+any torn layout (missing ``meta.pkl``, undecodable pickle, metadata that
+references an array payload that is not there) instead of surfacing a
+``FileNotFoundError`` from deep inside pickle/tensorstore.
 """
 
 from __future__ import annotations
 
 import os
 import pickle
-from typing import Any, Optional
+import threading
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import numpy as np
 
+from deepspeed_tpu.runtime.checkpoint_engine.atomic import (
+    COMPLETE_MARKER,
+    CheckpointCorruptError,
+    atomic_write_bytes,
+    clear_stale_staging,
+    commit_staged,
+    staging_dir,
+)
 from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import CheckpointEngine
+from deepspeed_tpu.utils import chaos
 from deepspeed_tpu.utils.logging import logger
 
 
@@ -34,6 +57,13 @@ class OrbaxCheckpointEngine(CheckpointEngine):
 
         self._ocp = ocp
         self._ckptr = ocp.StandardCheckpointer()
+        # tag/basename -> (staging_dir, final_path), staged by save(),
+        # renamed into place by commit(). Locked: the async writer thread
+        # and a synchronous save on the main thread may share one engine,
+        # and an unlocked copy-then-clear could wipe a concurrently staged
+        # entry without ever committing it.
+        self._staged: Dict[str, Tuple[str, str]] = {}
+        self._staged_lock = threading.Lock()
 
     def create(self, tag: str) -> None:
         logger.info(f"[OrbaxCheckpointEngine] Saving checkpoint under tag {tag}")
@@ -58,22 +88,70 @@ class OrbaxCheckpointEngine(CheckpointEngine):
             return {"__meta_ref__": prefix}
 
         skeleton = split("root", state_dict)
-        os.makedirs(path, exist_ok=True)
+        clear_stale_staging(path)
+        staging = staging_dir(path)
+        os.makedirs(staging, exist_ok=True)
         if arrays:
-            self._ckptr.save(os.path.join(path, "arrays"), arrays, force=True)
+            self._ckptr.save(os.path.join(staging, "arrays"), arrays, force=True)
             self._ckptr.wait_until_finished()
-        with open(os.path.join(path, "meta.pkl"), "wb") as f:
-            pickle.dump({"skeleton": skeleton, "meta": meta}, f)
+        chaos.point("ckpt.mid_array_write", path=staging)
+        # staged writes are invisible until commit, but each file is still
+        # written atomically so a re-entrant save over live staging (cannot
+        # happen today; belt and braces) never tears it
+        atomic_write_bytes(
+            os.path.join(staging, "meta.pkl"),
+            pickle.dumps({"skeleton": skeleton, "meta": meta}),
+        )
+        # the sentinel is LAST: a staging dir without it is by definition a
+        # torn, never-committable snapshot
+        atomic_write_bytes(os.path.join(staging, COMPLETE_MARKER), b"ok")
+        with self._staged_lock:
+            self._staged[os.path.basename(path)] = (staging, path)
 
     def load(self, path: str, map_location=None, target=None):  # noqa: ARG002
         path = os.path.abspath(path)
-        with open(os.path.join(path, "meta.pkl"), "rb") as f:
-            blob = pickle.load(f)
-        skeleton, meta = blob["skeleton"], blob["meta"]
+        if not os.path.isdir(path):
+            raise CheckpointCorruptError(f"no checkpoint directory at {path}")
+        meta_path = os.path.join(path, "meta.pkl")
+        if not os.path.isfile(meta_path):
+            raise CheckpointCorruptError(
+                f"torn checkpoint at {path}: meta.pkl is missing (the save "
+                "was killed before commit, or the directory was truncated)"
+            )
+        try:
+            with open(meta_path, "rb") as f:
+                blob = pickle.load(f)
+            skeleton, meta = blob["skeleton"], blob["meta"]
+        except CheckpointCorruptError:
+            raise
+        except Exception as e:
+            raise CheckpointCorruptError(
+                f"torn checkpoint at {path}: meta.pkl is unreadable ({type(e).__name__}: {e})"
+            ) from e
+
+        def has_array_refs(obj) -> bool:
+            if isinstance(obj, dict):
+                if "__array_ref__" in obj:
+                    return True
+                it = obj["items"] if "__seq__" in obj else obj.values()
+                return any(has_array_refs(v) for v in it)
+            return False
+
         arrays_path = os.path.join(path, "arrays")
         arrays = {}
-        if os.path.exists(arrays_path):
-            arrays = self._ckptr.restore(arrays_path)
+        if has_array_refs(skeleton):
+            if not os.path.exists(arrays_path):
+                raise CheckpointCorruptError(
+                    f"torn checkpoint at {path}: meta.pkl references an array "
+                    "payload but arrays/ is missing"
+                )
+            try:
+                arrays = self._ckptr.restore(arrays_path)
+            except Exception as e:
+                raise CheckpointCorruptError(
+                    f"torn checkpoint at {path}: array payload unreadable "
+                    f"({type(e).__name__}: {e})"
+                ) from e
 
         # reassemble
         def join(obj):
@@ -91,5 +169,27 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         return join(skeleton)
 
     def commit(self, tag: str) -> bool:
+        """Rename the checkpoint staged under ``tag`` into place — THE
+        atomic commit point. When ``tag`` has no staged entry of its own (a
+        caller that staged under a different basename, e.g. the Nebula
+        engine's tag-vs-path split), every pending entry is committed
+        instead of leaked. Entries are popped under the lock, so each
+        staged checkpoint is committed exactly once even when the async
+        writer thread and a synchronous save share this engine."""
+        with self._staged_lock:
+            if tag in self._staged:
+                pending = {tag: self._staged.pop(tag)}
+            else:
+                pending, self._staged = self._staged, {}
+        for staging, final in pending.values():
+            commit_staged(staging, final)
         logger.info(f"[OrbaxCheckpointEngine] Checkpoint {tag} is ready")
         return True
+
+    def discard_staged(self, tag: str) -> None:
+        """Forget a staged entry WITHOUT touching disk — the non-zero
+        ranks of a collective save call this while rank 0 commits: all
+        ranks staged into the same shared directory, so exactly one
+        process may perform (and must not race on) the rename."""
+        with self._staged_lock:
+            self._staged.pop(tag, None)

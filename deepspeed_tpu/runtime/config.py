@@ -243,10 +243,31 @@ class MonitorConfig(DeepSpeedConfigModel):
 
 
 class CheckpointConfig(DeepSpeedConfigModel):
+    """Checkpoint controls. The fault-tolerance knobs (ISSUE 9):
+
+    ``async_snapshot`` hides checkpoint persistence behind training compute
+    — ``save_checkpoint`` snapshots the donated state tuple device→host
+    (the only on-step cost, recorded as ``ckpt_stall_ms``) and a background
+    writer runs the staged atomic save + commit + ``latest`` update
+    (``checkpoint_engine/async_snapshot.py``). ``interval_steps`` > 0 with
+    ``save_dir`` set auto-saves every N optimizer steps from inside the
+    step bookkeeping, so a preempted run resumes via
+    ``load_checkpoint(save_dir, auto_resume=True)`` losing at most N-1
+    steps — and, because the payload carries the full replay state (RNG
+    key, data cursor, loss scale, counters, LR schedule), losing ZERO
+    information: the resumed losses are bit-identical to an uninterrupted
+    run. ``max_inflight_snapshots`` bounds host RAM at that many state
+    copies (double-buffered by default)."""
+
     tag_validation: str = "Warn"
     load_universal: bool = False
     use_node_local_storage: bool = False
     parallel_write: Dict[str, Any] = Field(default_factory=dict)
+    # fault tolerance -----------------------------------------------------
+    async_snapshot: bool = False
+    interval_steps: int = 0  # 0 = no auto-save
+    save_dir: Optional[str] = None  # auto-save target (required for interval)
+    max_inflight_snapshots: int = 2
 
 
 class DataTypesConfig(DeepSpeedConfigModel):

@@ -25,21 +25,58 @@ def _default_collate(items):
 
 
 class RepeatingLoader:
-    """Wraps an iterator to restart on StopIteration (reference pipe utils)."""
+    """Wraps an iterator to restart on StopIteration (reference pipe utils).
+
+    Carries a resumable cursor for exact-resume checkpointing: when the
+    wrapped loader exposes ``state_dict``/``load_state_dict`` (as
+    ``DeepSpeedDataLoader`` does) the inner cursor is delegated to;
+    otherwise the served-batch count is recorded and replayed best-effort."""
 
     def __init__(self, loader):
         self.loader = loader
         self.data_iter = iter(self.loader)
+        self.batches_served = 0
 
     def __iter__(self):
         return self
 
     def __next__(self):
         try:
-            return next(self.data_iter)
+            batch = next(self.data_iter)
         except StopIteration:
             self.data_iter = iter(self.loader)
-            return next(self.data_iter)
+            batch = next(self.data_iter)
+        self.batches_served += 1
+        return batch
+
+    def state_dict(self):
+        sd = {"batches_served": self.batches_served}
+        if hasattr(self.loader, "state_dict"):
+            sd["loader"] = self.loader.state_dict()
+        return sd
+
+    def load_state_dict(self, sd) -> None:
+        self.batches_served = int(sd.get("batches_served", 0))
+        if "loader" in sd and hasattr(self.loader, "load_state_dict"):
+            self.loader.load_state_dict(sd["loader"])
+            self.data_iter = iter(self.loader)
+            return
+        # opaque inner iterable: replay from the start (deterministic
+        # loaders land on the same cursor; anything else cannot be resumed
+        # exactly and should expose state_dict itself). Replay restarts on
+        # exhaustion exactly like __next__ — batches_served is cumulative
+        # across wraparounds, so an unsized loader replays whole passes.
+        self.data_iter = iter(self.loader)
+        try:
+            n = len(self.loader)
+        except TypeError:
+            n = 0
+        for _ in range(self.batches_served % n if n else self.batches_served):
+            try:
+                next(self.data_iter)
+            except StopIteration:
+                self.data_iter = iter(self.loader)
+                next(self.data_iter)
 
 
 class DeepSpeedDataLoader:
@@ -63,6 +100,11 @@ class DeepSpeedDataLoader:
         self.post_process_func = None
         self.data_sampler = data_sampler
         self.epoch = 0
+        # resumable data cursor (exact-resume checkpointing): batches
+        # yielded in the current epoch, saved via state_dict and consumed
+        # ONCE by the next __iter__ after load_state_dict
+        self._cursor = 0
+        self._resume_cursor = 0
         try:
             self._len = len(dataset)
         except TypeError:
@@ -76,7 +118,26 @@ class DeepSpeedDataLoader:
         return math.ceil(self._len / self.batch_size)
 
     def set_epoch(self, epoch: int) -> None:
+        """Select the epoch; cursors reset only when it actually CHANGES.
+        The canonical resumed loop calls ``set_epoch(current_epoch)`` right
+        after ``load_checkpoint`` — that must not wipe the restored
+        mid-epoch cursor, or the resumed run silently re-serves already
+        trained batches."""
+        if epoch != self.epoch:
+            self._cursor = 0
+            self._resume_cursor = 0
         self.epoch = epoch
+
+    def state_dict(self) -> dict:
+        """The data cursor: where in which epoch the loader stands. Saved
+        into checkpoints so an ``auto_resume`` run replays the EXACT batch
+        sequence an uninterrupted run would have seen."""
+        return {"epoch": self.epoch, "cursor": self._cursor}
+
+    def load_state_dict(self, sd: dict) -> None:
+        self.epoch = int(sd.get("epoch", 0))
+        self._cursor = int(sd.get("cursor", 0))
+        self._resume_cursor = self._cursor
 
     def _indices(self):
         n = self._len
@@ -89,17 +150,29 @@ class DeepSpeedDataLoader:
         return order
 
     def __iter__(self):
+        start, self._resume_cursor = self._resume_cursor, 0
         if self._len is None:
-            # iterable dataset: batch on the fly
-            for batch in self._iter_stream():
+            # iterable dataset: batch on the fly (resume = deterministic
+            # replay past the already-consumed batches)
+            for b, batch in enumerate(self._iter_stream()):
+                if b < start:
+                    continue
+                self._cursor = b + 1
                 yield self._post(batch)
+            self.epoch += 1
+            self._cursor = 0
             return
         order = self._indices()
         n_batches = len(self)
-        for b in range(n_batches):
+        for b in range(min(start, n_batches), n_batches):
             idx = order[b * self.batch_size : (b + 1) * self.batch_size]
             items = [self.dataset[int(i)] for i in idx]
+            self._cursor = b + 1
             yield self._post(self.collate_fn(items))
+        # a completed pass rolls the cursor into the next epoch, so a
+        # RepeatingLoader's wraparound is captured in the saved state
+        self.epoch += 1
+        self._cursor = 0
 
     def _post(self, batch):
         """Data-efficiency hook (reference engine.set_data_post_process_func

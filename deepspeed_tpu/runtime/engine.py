@@ -55,6 +55,17 @@ from deepspeed_tpu.profiling.compile_telemetry import (
     configure_persistent_cache,
 )
 from deepspeed_tpu.runtime import constants as C
+from deepspeed_tpu.runtime.checkpoint_engine.atomic import (
+    CheckpointCorruptError,
+    CheckpointLoadError,
+    list_valid_tags,
+    write_latest_marker,
+)
+from deepspeed_tpu.runtime.checkpoint_engine.async_snapshot import (
+    AsyncCheckpointWriter,
+    host_snapshot,
+    tree_fully_addressable,
+)
 from deepspeed_tpu.runtime.checkpoint_engine.orbax_checkpoint_engine import OrbaxCheckpointEngine
 from deepspeed_tpu.runtime.config import DeepSpeedConfig
 from deepspeed_tpu.runtime.fp16.loss_scaler import (
@@ -323,6 +334,17 @@ class DeepSpeedEngine:
 
         # checkpoint engine ----------------------------------------------
         self.checkpoint_engine = OrbaxCheckpointEngine(self._config)
+        # async atomic checkpointing (checkpoint.async_snapshot): created
+        # lazily on the first async save; double-buffered background writer
+        self._ckpt_writer: Optional[AsyncCheckpointWriter] = None
+        self._ckpt_metrics = {
+            "saves": 0,
+            "async_saves": 0,
+            "last_stall_ms": 0.0,  # device->host snapshot time (async path)
+            "total_stall_ms": 0.0,
+            "last_save_s": 0.0,  # full persist wall time (staging+commit)
+            "last_restore_s": 0.0,
+        }
 
         # state (lazily initialized on first batch or from model_parameters)
         self._initialized = False
@@ -1818,6 +1840,12 @@ class DeepSpeedEngine:
             if self.quantizer.out_shardings is None:
                 self.quantizer.out_shardings = self._param_shardings
             self._params = self.quantizer.quantize_tree(self._params, self.global_steps)
+        # interval auto-save (checkpoint.interval_steps + save_dir): the
+        # preemption-survival loop — with async_snapshot on, the step only
+        # pays the device->host snapshot
+        ccfg = self._config.checkpoint_config
+        if ccfg.save_dir and ccfg.interval_steps > 0 and self.global_steps % ccfg.interval_steps == 0:
+            self.save_checkpoint(ccfg.save_dir)
         if self.monitor is not None and self.global_steps % self._config.steps_per_print == 0:
             self._write_monitor()
 
@@ -2062,7 +2090,19 @@ class DeepSpeedEngine:
     def _ckpt_dir(self, save_dir: str, tag: str) -> str:
         return os.path.join(save_dir, str(tag))
 
-    def save_checkpoint(self, save_dir: str, tag: Optional[str] = None, client_state: Optional[Dict] = None, save_latest: bool = True, exclude_frozen_parameters: bool = False):  # noqa: ARG002
+    def save_checkpoint(self, save_dir: str, tag: Optional[str] = None, client_state: Optional[Dict] = None, save_latest: bool = True, exclude_frozen_parameters: bool = False, asynchronous: Optional[bool] = None):  # noqa: ARG002
+        """Write one atomic checkpoint under ``save_dir/tag``.
+
+        The payload carries the FULL replay state — module/master/optimizer
+        trees, loss-scale state, LR-schedule state, step counters, the PRNG
+        key, and the data-sampler cursor — so a
+        ``load_checkpoint(auto_resume=True)`` run produces losses
+        bit-identical to the uninterrupted one. Persistence is atomic
+        (stage → fsync → rename, then the ``latest`` marker): a ``kill -9``
+        at any instant leaves the newest *valid* checkpoint discoverable.
+        ``asynchronous`` (default: ``checkpoint.async_snapshot``) snapshots
+        device→host and persists from a background writer so the step loop
+        only pays the D2H copy (``checkpoint_stats()['last_stall_ms']``)."""
         if not self._initialized:
             raise RuntimeError("cannot save before the engine state is initialized")
         if self._pending_commit is not None:
@@ -2107,18 +2147,99 @@ class DeepSpeedEngine:
             "global_samples": self.global_samples,
             "micro_steps": self.micro_steps,
             "skipped_steps": self.skipped_steps,
+            # exact-resume replay state: the PRNG key the next step would
+            # split, the data-sampler cursor, and the mesh topology (a
+            # load into a different mesh fails loudly, not via reshape)
+            "rng": np.asarray(jax.device_get(self._rng)),
+            "data_cursor": (
+                self.training_dataloader.state_dict()
+                if self.training_dataloader is not None
+                and hasattr(self.training_dataloader, "state_dict")
+                else None
+            ),
+            "mesh": dict(zip(self.mesh.axis_names, map(int, self.mesh.devices.shape))),
             "ds_config": self._config._param_dict,
             "ds_version": _version(),
             "client_state": client_state or {},
         }
-        self.checkpoint_engine.save(state, path)
-        if save_latest and dist.get_rank() == 0:
-            os.makedirs(save_dir, exist_ok=True)
-            with open(os.path.join(save_dir, "latest"), "w") as f:
-                f.write(tag)
-        self.checkpoint_engine.commit(tag)
+        update_latest = save_latest and dist.get_rank() == 0
+        use_async = (
+            self._config.checkpoint_config.async_snapshot
+            if asynchronous is None
+            else bool(asynchronous)
+        )
+        if use_async and (
+            dist.get_world_size() > 1 or not tree_fully_addressable(state)
+        ):
+            # multi-process saves are collective (every rank participates
+            # in one orbax write to one shared dir; rank 0 commits) and a
+            # cross-process global array has no single-host copy — both
+            # must go through the synchronous path
+            logger.warning(
+                "async_snapshot: multi-process / non-addressable state — "
+                "falling back to a synchronous collective save"
+            )
+            use_async = False
+        if not use_async:
+            # a synchronous save (including the fallback above) must not
+            # interleave with queued async writes: an in-flight older
+            # snapshot finishing AFTER this save would regress the latest
+            # marker (and a same-tag re-save would reclaim the writer's
+            # live staging dir)
+            self.wait_pending_checkpoint()
+        t0 = time.perf_counter()
+        if use_async:
+            if self._ckpt_writer is None:
+                self._ckpt_writer = AsyncCheckpointWriter(
+                    self.checkpoint_engine,
+                    max_inflight=self._config.checkpoint_config.max_inflight_snapshots,
+                )
+            # the ONLY on-step cost: device->host of the state tuple. It
+            # must complete before returning — the step programs donate
+            # these buffers, so the next dispatch invalidates them.
+            host_state = host_snapshot(state)
+            stall_ms = (time.perf_counter() - t0) * 1e3
+            self._ckpt_writer.submit(
+                host_state, path, tag, save_dir if update_latest else None
+            )
+            self._ckpt_metrics["async_saves"] += 1
+            self._ckpt_metrics["last_stall_ms"] = stall_ms
+            self._ckpt_metrics["total_stall_ms"] += stall_ms
+        else:
+            self.checkpoint_engine.save(state, path)
+            # the save was collective (all ranks, one shared staging dir);
+            # the commit rename is rank 0's alone — and it happens BEFORE
+            # the latest marker, which may only ever name a fully
+            # committed checkpoint
+            if dist.get_rank() == 0:
+                self.checkpoint_engine.commit(tag)
+                if update_latest:
+                    write_latest_marker(save_dir, tag)
+            else:
+                self.checkpoint_engine.discard_staged(tag)
+            self._ckpt_metrics["last_save_s"] = time.perf_counter() - t0
+        self._ckpt_metrics["saves"] += 1
         dist.barrier(name="save_checkpoint")
         return True
+
+    def wait_pending_checkpoint(self) -> None:
+        """Fence the async checkpoint writer: returns once every queued
+        snapshot is committed; re-raises a background persist failure."""
+        if self._ckpt_writer is not None:
+            self._ckpt_writer.wait()
+            if self._ckpt_writer.saves:
+                self._ckpt_metrics["last_save_s"] = self._ckpt_writer.last_save_s
+
+    def checkpoint_stats(self) -> Dict[str, Any]:
+        """Checkpoint telemetry next to ``compile_stats()``: save counts,
+        the async snapshot stall (``last_stall_ms`` — the step-time hit
+        while a write is in flight; the bench records it as
+        ``ckpt_stall_ms``), full persist and restore wall times, and the
+        writer's queue depth."""
+        out = dict(self._ckpt_metrics)
+        out["async_snapshot"] = self._config.checkpoint_config.async_snapshot
+        out["pending"] = self._ckpt_writer.pending() if self._ckpt_writer else 0
+        return out
 
     def _validate_checkpoint_tag(self, tag: str) -> str:
         """Cross-rank tag equality check (reference engine.py:2944).
@@ -2144,26 +2265,66 @@ class DeepSpeedEngine:
         self,
         load_dir: str,
         tag: Optional[str] = None,
-        load_module_strict: bool = True,  # noqa: ARG002
+        load_module_strict: bool = True,
         load_optimizer_states: bool = True,
         load_lr_scheduler_states: bool = True,
         load_module_only: bool = False,
         custom_load_fn: Optional[Callable] = None,  # noqa: ARG002
+        auto_resume: bool = False,
     ):
+        """Load a checkpoint. With ``auto_resume=True`` the newest VALID
+        checkpoint under ``load_dir`` is discovered by scanning and
+        validating every tag (the ``latest`` marker is only a hint — a kill
+        between commit and the marker update leaves a newer valid
+        checkpoint unnamed), the full replay state (PRNG key, data cursor,
+        loss scale, counters, LR schedule) is restored, and the resumed
+        run's losses are bit-identical to an uninterrupted one. With
+        ``load_module_strict`` (default) every module leaf is validated
+        against the live state first — a shape/dtype/mesh mismatch raises
+        one clear ``CheckpointLoadError`` naming the offending leaf."""
+        self.wait_pending_checkpoint()
+        t_load = time.perf_counter()
+        state = None
         if tag is None:
-            latest = os.path.join(load_dir, "latest")
-            if not os.path.isfile(latest):
-                logger.warning(f"no 'latest' file at {latest}; nothing loaded")
-                return None, {}
-            with open(latest) as f:
-                tag = f.read().strip()
+            if auto_resume:
+                # newest valid first, falling back past any tag that turns
+                # out torn at load time (a structurally complete-looking
+                # directory can still fail its pickle/array restore —
+                # CheckpointCorruptError means 'skip this tag', not 'die')
+                for cand in reversed(list_valid_tags(load_dir)):
+                    try:
+                        state = self.checkpoint_engine.load(
+                            self._ckpt_dir(load_dir, cand)
+                        )
+                        tag = cand
+                        break
+                    except CheckpointCorruptError as e:
+                        logger.warning(
+                            f"auto_resume: skipping torn checkpoint {cand}: {e}"
+                        )
+                if state is None:
+                    logger.warning(
+                        f"auto_resume: no valid checkpoint under {load_dir}; "
+                        "nothing loaded (fresh start)"
+                    )
+                    return None, {}
+            else:
+                latest = os.path.join(load_dir, "latest")
+                if not os.path.isfile(latest):
+                    logger.warning(f"no 'latest' file at {latest}; nothing loaded")
+                    return None, {}
+                with open(latest) as f:
+                    tag = f.read().strip()
         path = self._ckpt_dir(load_dir, tag)
-        state = self.checkpoint_engine.load(path)
+        if state is None:
+            state = self.checkpoint_engine.load(path)
         if not self._initialized:
             raise RuntimeError(
                 "engine state must be initialized before load_checkpoint (call init_params "
                 "with a sample batch, or run one forward)"
             )
+        if load_module_strict:
+            self._validate_checkpoint_state(state, path)
         if self._param_stream is not None:
             opt_state = state.get("optimizer")
             if not (isinstance(opt_state, dict) and "param_stream" in opt_state):
@@ -2187,8 +2348,10 @@ class DeepSpeedEngine:
                 self.global_samples = state.get("global_samples", 0)
                 self.micro_steps = state.get("micro_steps", 0)
                 self.skipped_steps = state.get("skipped_steps", 0)
+                self._restore_replay_state(state)
                 if self.progressive_layer_drop is not None:
                     self.progressive_layer_drop.update_state(self.global_steps)
+            self._ckpt_metrics["last_restore_s"] = time.perf_counter() - t_load
             return path, state.get("client_state", {})
         # non-offload fp32: module state IS the master — place it with the
         # master sharding the (donating) step programs pin, mirroring
@@ -2261,12 +2424,89 @@ class DeepSpeedEngine:
             self.global_samples = state.get("global_samples", 0)
             self.micro_steps = state.get("micro_steps", 0)
             self.skipped_steps = state.get("skipped_steps", 0)
+            self._restore_replay_state(state)
             if self.progressive_layer_drop is not None:
                 # theta is a pure function of global_steps — recompute it so
                 # the first resumed step drops layers like an uninterrupted run
                 self.progressive_layer_drop.update_state(self.global_steps)
         client_state = state.get("client_state", {})
+        self._ckpt_metrics["last_restore_s"] = time.perf_counter() - t_load
         return path, client_state
+
+    def _restore_replay_state(self, state: Dict) -> None:
+        """The exact-resume tail: the PRNG key the next step will split and
+        the data-sampler cursor. Checkpoints from before these fields
+        existed load as before (a warning, not an error — their resume is
+        correct-but-not-bit-identical)."""
+        rng = state.get("rng")
+        if rng is not None:
+            self._rng = jnp.asarray(np.asarray(rng))
+        else:
+            logger.warning(
+                "checkpoint carries no RNG state (pre-fault-tolerance save): "
+                "resumed dropout/LTD streams will diverge from the "
+                "uninterrupted run"
+            )
+        cursor = state.get("data_cursor")
+        if (
+            cursor
+            and self.training_dataloader is not None
+            and hasattr(self.training_dataloader, "load_state_dict")
+        ):
+            self.training_dataloader.load_state_dict(cursor)
+
+    def _validate_checkpoint_state(self, state: Dict, path: str) -> None:
+        """Fail fast, with names: a checkpoint whose mesh topology or module
+        leaves disagree with the live run must raise ONE clear error — not
+        a tree-unflatten or reshape failure three layers down."""
+        saved_mesh = state.get("mesh")
+        if saved_mesh is not None:
+            cur_mesh = dict(zip(self.mesh.axis_names, map(int, self.mesh.devices.shape)))
+            if dict(saved_mesh) != cur_mesh:
+                raise CheckpointLoadError(
+                    f"mesh topology mismatch loading {path}: checkpoint was "
+                    f"saved on mesh {dict(saved_mesh)} but this run uses "
+                    f"{cur_mesh}; re-shard the checkpoint or rebuild the "
+                    "engine with the saved topology"
+                )
+        module = state.get("module")
+        if module is None or self._params is None:
+            return  # offload layouts validate their own stores
+        from deepspeed_tpu.utils.tensor_fragment import _flatten_with_paths
+
+        saved = _flatten_with_paths(module)
+        cur = _flatten_with_paths(self._params)
+        missing = sorted(set(cur) - set(saved))
+        extra = sorted(set(saved) - set(cur))
+        if missing or extra:
+            raise CheckpointLoadError(
+                f"module tree mismatch loading {path}: "
+                + (f"checkpoint lacks {missing[:3]}" if missing else "")
+                + (" and " if missing and extra else "")
+                + (f"checkpoint has unknown {extra[:3]}" if extra else "")
+                + " (pass load_module_strict=False to adopt loosely)"
+            )
+        for name in cur:
+            s_leaf, c_leaf = saved[name], cur[name]
+            s_shape = tuple(np.shape(s_leaf))
+            c_shape = tuple(np.shape(c_leaf))
+            if s_shape != c_shape:
+                raise CheckpointLoadError(
+                    f"shape mismatch loading {path} at module leaf "
+                    f"{name!r}: checkpoint has {s_shape}, current state has "
+                    f"{c_shape} (model config differs from the one that "
+                    "saved this checkpoint)"
+                )
+            s_dtype = np.dtype(getattr(s_leaf, "dtype", np.asarray(s_leaf).dtype))
+            c_dtype = np.dtype(c_leaf.dtype)
+            if s_dtype != c_dtype:
+                raise CheckpointLoadError(
+                    f"dtype mismatch loading {path} at module leaf "
+                    f"{name!r}: checkpoint has {s_dtype}, current state has "
+                    f"{c_dtype} (precision config differs from the run that "
+                    "saved this checkpoint; pass load_module_strict=False "
+                    "to skip validation)"
+                )
 
     def consolidated_16bit_state_dict(self) -> Dict[str, Any]:
         """Full compute-dtype weights as a flat host dict (reference

@@ -83,6 +83,14 @@ class _LRSchedulerBase:
 
     def load_state_dict(self, sd):
         self.last_batch_iteration = sd["last_batch_iteration"]
+        # re-apply the restored schedule to optimizer.param_groups: an
+        # uninterrupted run's step() already wrote this lr after the last
+        # pre-save step, so a resumed run must start from the same value —
+        # without this the first post-resume update silently consumes the
+        # fresh-engine init lr (exact-resume parity catches it as a loss
+        # divergence on the SECOND resumed step)
+        if self.last_batch_iteration >= 0:
+            self._update_lrs(self.get_lr())
 
 
 class LRRangeTest(_LRSchedulerBase):

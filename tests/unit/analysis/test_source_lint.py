@@ -296,6 +296,83 @@ def test_r007_pragma_suppresses_and_is_error_severity():
     assert resolve_severity(r007[0]) == "error"
 
 
+def test_r008_nonatomic_write_in_checkpoint_file_flagged():
+    src = """
+        def persist(path, data):
+            with open(path, "wb") as f:
+                f.write(data)
+    """
+    findings = lint_source(
+        textwrap.dedent(src),
+        path="deepspeed_tpu/runtime/checkpoint_engine/foo_engine.py",
+    )
+    assert [f.rule for f in findings] == ["DS-R008"]
+    # same code in an unrelated file: out of scope
+    assert not lint_source(textwrap.dedent(src), path="deepspeed_tpu/ops/foo.py")
+
+
+def test_r008_checkpoint_function_flagged_in_any_file():
+    src = """
+        import os
+        def save_checkpoint(save_dir, tag):
+            with open(os.path.join(save_dir, "latest"), "w") as f:
+                f.write(tag)
+    """
+    rules = [
+        f.rule
+        for f in lint_source(textwrap.dedent(src), path="deepspeed_tpu/runtime/engine.py")
+    ]
+    assert "DS-R008" in rules
+
+
+def test_r008_sanctioned_patterns_quiet():
+    """temp+rename staging, append-only logs, and reads are the sanctioned
+    idioms — none may flag."""
+    src = """
+        import os
+        def save_checkpoint(path, data, tag):
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:          # staged: the atomic pattern
+                f.write(data)
+            os.replace(tmp, path)
+            with open(path + ".journal", "ab") as f:  # append-only journal
+                f.write(data)
+            with open(path, "rb") as f:          # read
+                return f.read()
+    """
+    findings = lint_source(
+        textwrap.dedent(src), path="deepspeed_tpu/runtime/checkpoint_engine/x.py"
+    )
+    assert "DS-R008" not in [f.rule for f in findings]
+
+
+def test_r008_pragma_suppresses_and_is_error_severity():
+    src = """
+        def write_journal(path, tag):
+            with open(path, "w") as f:  # lint: allow(DS-R008)
+                f.write(tag)
+    """
+    assert "DS-R008" not in [
+        f.rule for f in lint_source(textwrap.dedent(src), path="deepspeed_tpu/inference/journal.py")
+    ]
+    bad = textwrap.dedent(src).replace("  # lint: allow(DS-R008)", "")
+    findings = lint_source(bad, path="deepspeed_tpu/inference/journal.py")
+    assert [f.rule for f in findings] == ["DS-R008"]
+    assert resolve_severity(findings[0]) == "error"
+
+
+def test_r008_bench_record_paths_in_scope():
+    src = """
+        import json
+        def _save_store(store, path):
+            with open(path, "w") as f:
+                json.dump(store, f)
+    """
+    assert "DS-R008" in [
+        f.rule for f in lint_source(textwrap.dedent(src), path="bench.py")
+    ]
+
+
 def test_severity_tests_path_is_warn_only():
     f = lint_source("import jax.numpy as jnp\nx = jnp.repeat(k_cache, 2)\n", path="tests/unit/foo.py")[0]
     assert f.rule == "DS-R001"

@@ -1,0 +1,110 @@
+"""Fault-injection harness units: deterministic schedules, nth-hit firing,
+file-damage actions, and clean disarm — the foundation the crash-restart
+matrix (test_fault_tolerance / test_journal_recovery) stands on."""
+
+import os
+
+import pytest
+
+from deepspeed_tpu.utils import chaos
+
+
+def teardown_function(_fn):
+    chaos.uninstall()  # no test may leak an armed schedule
+
+
+def test_disarmed_points_are_free():
+    chaos.uninstall()
+    chaos.point("ckpt.pre_commit")  # no schedule: must be a no-op
+    assert chaos.active() is None
+
+
+def test_fires_on_nth_hit_only():
+    sched = chaos.install(chaos.ChaosSchedule([chaos.ChaosRule("p", hit=3)]))
+    chaos.point("p")
+    chaos.point("p")
+    with pytest.raises(chaos.ChaosKilled):
+        chaos.point("p")
+    # a fired rule never re-fires
+    chaos.point("p")
+    assert sched.fired_log == ["p#3:raise"]
+    assert sched.counts["p"] == 4
+
+
+def test_points_are_independent_counters():
+    chaos.install(chaos.ChaosSchedule([chaos.ChaosRule("a", hit=1)]))
+    chaos.point("b")
+    chaos.point("b")
+    with pytest.raises(chaos.ChaosKilled):
+        chaos.point("a")
+
+
+def test_chaoskilled_is_not_an_exception():
+    """The kill must not be swallowable by ordinary recovery code —
+    ``except Exception`` around the injection point must not survive it."""
+    assert not issubclass(chaos.ChaosKilled, Exception)
+    chaos.install(chaos.ChaosSchedule([chaos.ChaosRule("p")]))
+    with pytest.raises(chaos.ChaosKilled):
+        try:
+            chaos.point("p")
+        except Exception:  # the pattern a kill must punch through
+            pytest.fail("ChaosKilled was swallowed by `except Exception`")
+
+
+def test_truncate_action_tears_the_file(tmp_path):
+    path = str(tmp_path / "seg.open")
+    with open(path, "wb") as f:
+        f.write(b"x" * 100)
+    chaos.install(
+        chaos.ChaosSchedule([chaos.ChaosRule("j", action="truncate", nbytes=30)])
+    )
+    with pytest.raises(chaos.ChaosKilled):
+        chaos.point("j", path=path)
+    assert os.path.getsize(path) == 70
+
+
+def test_corrupt_action_is_deterministic(tmp_path):
+    p1, p2 = str(tmp_path / "a"), str(tmp_path / "b")
+    for p in (p1, p2):
+        with open(p, "wb") as f:
+            f.write(bytes(range(64)))
+        chaos.install(
+            chaos.ChaosSchedule([chaos.ChaosRule("c", action="corrupt", nbytes=16)])
+        )
+        with pytest.raises(chaos.ChaosKilled):
+            chaos.point("c", path=p)
+        chaos.uninstall()
+    with open(p1, "rb") as f1, open(p2, "rb") as f2:
+        b1, b2 = f1.read(), f2.read()
+    assert b1 == b2  # position-keyed garbage, not random
+    assert b1[:48] == bytes(range(48)) and b1[48:] != bytes(range(48, 64))
+
+
+def test_truncate_on_directory_path_still_kills(tmp_path):
+    """A truncate/corrupt rule landing on a directory-backed point (the
+    checkpoint staging dirs) degrades to the plain kill — never a
+    swallowable IsADirectoryError."""
+    chaos.install(
+        chaos.ChaosSchedule([chaos.ChaosRule("p", action="truncate")])
+    )
+    with pytest.raises(chaos.ChaosKilled):
+        chaos.point("p", path=str(tmp_path))
+    assert os.path.isdir(tmp_path)
+
+
+def test_seeded_schedule_reproducible():
+    s1 = chaos.seeded_schedule(7, n_faults=3)
+    s2 = chaos.seeded_schedule(7, n_faults=3)
+    assert [(r.point, r.hit, r.action) for r in s1.rules] == [
+        (r.point, r.hit, r.action) for r in s2.rules
+    ]
+    s3 = chaos.seeded_schedule(8, n_faults=3)
+    assert [(r.point, r.hit) for r in s1.rules] != [(r.point, r.hit) for r in s3.rules]
+    assert all(r.point in chaos.POINTS for r in s1.rules)
+
+
+def test_bad_rule_rejected():
+    with pytest.raises(ValueError):
+        chaos.ChaosRule("p", action="nuke")
+    with pytest.raises(ValueError):
+        chaos.ChaosRule("p", hit=0)

@@ -35,6 +35,15 @@
 # EOS, ≤2-compiled-programs + 1-dispatch-per-step + 3-wave retrace
 # guards, ragged attention kernel parity (XLA fallback + Pallas
 # interpret), ragged program green sweep.
+# +fault tolerance 2026-08-04 (test_fault_tolerance.py +
+# test_journal_recovery.py + test_chaos.py): atomic staged-commit
+# checkpoint layout, in-process chaos kills at every ckpt/serve injection
+# point, auto_resume bit-identical losses (bf16 + fp16 dynamic scale),
+# async-snapshot parity + zero-new-programs telemetry guard, torn-file /
+# torn-journal red tests, byte-identical stream recovery, DS-R008 lint.
+# The FULL subprocess kill -9 matrix is `pytest -m slow
+# tests/unit/checkpoint/test_chaos_matrix.py` (excluded here and from
+# tier-1).
 cd "$(dirname "$0")/.." || exit 1
 sh tools/lint.sh || exit 1
 exec python -m pytest -q \
@@ -47,6 +56,9 @@ exec python -m pytest -q \
   tests/unit/runtime/test_runtime_utils.py \
   tests/unit/runtime/test_moq.py \
   tests/unit/runtime/zero \
+  tests/unit/checkpoint/test_fault_tolerance.py \
+  tests/unit/inference/test_journal_recovery.py \
+  tests/unit/utils/test_chaos.py \
   tests/unit/inference/test_kv_pool.py \
   tests/unit/inference/test_serving.py \
   tests/unit/inference/test_ragged_serving.py \
