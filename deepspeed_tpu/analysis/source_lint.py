@@ -41,6 +41,16 @@ Rules (each one traces back to a real incident in PERF.md / PR history):
   tmp/staging/partial identifier in the path expression) are the
   sanctioned pattern and exempt. Append-mode opens are fine — append-only
   logs tolerate torn tails by design (CRC-gated replay).
+* **DS-R009 raw-clock-in-step-loop** — a raw ``time.time()`` /
+  ``time.perf_counter()`` / ``time.monotonic()`` call, or a ``device_sync``
+  (full async-dispatch drain), inside a step-loop method of an
+  ``*Engine`` / ``*Server`` / ``*Scheduler`` class: ad-hoc timing forks a
+  second, invisible timeline next to the unified tracer (ISSUE 10), and a
+  stray ``device_sync`` serializes host and device on every step (the
+  ``SynchronizedWallClockTimer.stop(sync=True)`` default this PR removed).
+  Route timing through the engine's tracer/timers (``profiling/tracer.py``,
+  ``utils/timer.py`` — both files are out of scope for the rule, as is
+  ``utils/sync.py``); deliberate exceptions carry a pragma.
 * **DS-R007 pool-internals-mutated-outside-pool** — writing ``PagePool``
   internals (page tables, seq lens, free lists, refcounts, the prefix
   index, or the device cache) from outside the pool's own methods: the
@@ -74,6 +84,7 @@ RULES = {
     "DS-R006": "blocking collective on parameters inside a scanned layer body",
     "DS-R007": "PagePool internals mutated outside the pool's own methods",
     "DS-R008": "non-atomic persistence write (open 'w' without temp+rename) in a checkpoint/journal/bench path",
+    "DS-R009": "raw clock / device_sync call inside an engine/scheduler step-loop method (route through the tracer/timer)",
 }
 _WARN_ONLY = {"DS-R003", "DS-R004"}
 
@@ -123,6 +134,21 @@ _HOT_FN = re.compile(
     r"^_?((plain_)?(decode|prefill|verify|spec)_(step|round)|step|run|serve)$"
 )
 _NP_CASTS = ("np.asarray", "np.array", "numpy.asarray", "numpy.array", "onp.asarray")
+
+# DS-R009 scope: step-loop methods of engine/server/scheduler classes —
+# the code that runs between (or around) every hot dispatch. The tracer /
+# timer / sync modules OWN the clocks and are exempt by path.
+_R009_EXEMPT_PATH = re.compile(r"(utils/timer\.py|utils/sync\.py|profiling/)")
+_R009_CLASS = re.compile(r"(Engine|Server|Scheduler)$")
+_R009_FN = re.compile(
+    r"^_?(forward|backward|step|train_batch|fused_train_batch|take_model_step"
+    r"|take_offload_step|generate|(plain_)?(decode|prefill|verify|spec|ragged)"
+    r"_(step|round)|admit|emit|run|serve|settle_spec_row|reserve_for_growth"
+    r"|finish_step_bookkeeping)$"
+)
+# call names that read a raw clock or drain the dispatch queue
+_R009_BASES = {"perf_counter", "monotonic", "device_sync", "perf_counter_ns", "monotonic_ns"}
+_R009_EXACT = {"time.time", "time.clock", "_sync"}
 
 _CACHEY = re.compile(
     r"(cache|page|pool|buffer|^kv$|^k$|^v$|^k_|^v_|_kv$|kv_)", re.IGNORECASE
@@ -389,6 +415,32 @@ def lint_source(src: str, path: str = "<string>") -> List[LintFinding]:
                         f"{fname} on a possible device value in {where} "
                         "(one fetch per dispatch is the budget)",
                     )
+
+    # ---- DS-R009: raw clocks / device syncs in step-loop methods ------
+    if not _R009_EXEMPT_PATH.search(path.replace(os.sep, "/")):
+        for cls in ast.walk(tree):
+            if not (isinstance(cls, ast.ClassDef) and _R009_CLASS.search(cls.name)):
+                continue
+            for fn in cls.body:
+                if not (
+                    isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and _R009_FN.match(fn.name)
+                ):
+                    continue
+                where = f"step-loop method {cls.name}.{fn.name}"
+                for n in ast.walk(fn):
+                    if not isinstance(n, ast.Call):
+                        continue
+                    fname = _dotted(n.func)
+                    base = fname.rsplit(".", 1)[-1]
+                    if fname in _R009_EXACT or base in _R009_BASES:
+                        add(
+                            n.lineno,
+                            "DS-R009",
+                            f"raw {fname}() in {where}: ad-hoc clocks fork the "
+                            "timeline (and device_sync serializes the step) — "
+                            "route through the engine tracer/timer",
+                        )
 
     # ---- DS-R006: blocking param collectives in scan bodies -----------
     scan_bodies: List[ast.AST] = []

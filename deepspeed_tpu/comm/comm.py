@@ -40,6 +40,18 @@ from deepspeed_tpu.utils.logging import logger
 cdb_initialized = False
 comms_logger = CommsLogger()
 _timers = {}
+# unified-tracing hookup (profiling/tracer.py): the engines hand their
+# tracer here so every control-plane collective lands on the same timeline
+# as the step phases. Module-level like comms_logger — the latest engine
+# wins, which matches the one-engine-per-process deployment shape.
+_comm_tracer = None
+
+
+def set_comm_tracer(tracer) -> None:
+    """Route ``comm.*`` spans (one per eager control-plane collective)
+    into the given tracer; ``None`` detaches."""
+    global _comm_tracer
+    _comm_tracer = tracer
 
 
 class DSCommError(RuntimeError):
@@ -152,14 +164,19 @@ def timed_op(func):
     @functools.wraps(func)
     def wrapper(*args, **kwargs):
         prof = getattr(comms_logger, "prof_all", False) or func.__name__ in comms_logger.prof_ops
-        if not prof:
+        trace = _comm_tracer is not None and _comm_tracer.enabled
+        if not (prof or trace):
             return func(*args, **kwargs)
         start = time.perf_counter()
         result = func(*args, **kwargs)
         if result is not None and hasattr(result, "block_until_ready"):
             result.block_until_ready()
-        latency_ms = (time.perf_counter() - start) * 1000.0
-        comms_logger.append(func.__name__, func.__name__, latency_ms, _nbytes(args))
+        end = time.perf_counter()
+        nbytes = _nbytes(args)
+        if prof:
+            comms_logger.append(func.__name__, func.__name__, (end - start) * 1000.0, nbytes)
+        if trace:
+            _comm_tracer.add_span(f"comm.{func.__name__}", start, end, bytes=nbytes)
         return result
 
     return wrapper

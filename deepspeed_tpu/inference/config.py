@@ -7,7 +7,7 @@ from typing import Any, Dict, List, Optional
 
 from pydantic import Field, model_validator
 
-from deepspeed_tpu.runtime.config import AnalysisConfig
+from deepspeed_tpu.runtime.config import AnalysisConfig, TracingConfig
 from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
 
 
@@ -192,6 +192,11 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     traffic: TrafficConfig = Field(default_factory=TrafficConfig)
     journal: JournalConfig = Field(default_factory=JournalConfig)
     analysis: AnalysisConfig = Field(default_factory=AnalysisConfig)
+    # unified tracing/metrics plane (profiling/tracer.py): serving step
+    # phases (admit/pack/dispatch/emit/journal-sync) + per-request
+    # lifecycle spans, merged by engine.observability(); same knobs as the
+    # training side incl. the crash flight recorder
+    tracing: TracingConfig = Field(default_factory=TracingConfig)
     checkpoint: Optional[Any] = None
     base_dir: str = ""
     set_empty_params: bool = False

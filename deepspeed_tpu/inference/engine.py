@@ -22,6 +22,7 @@ import numpy as np
 from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig, DtypeEnum
 from deepspeed_tpu.parallel.mesh import get_topology
 from deepspeed_tpu.profiling.compile_telemetry import CompileTelemetry
+from deepspeed_tpu.profiling.tracer import MetricsRegistry, ObservabilityHub, Tracer
 from deepspeed_tpu.runtime.module import wrap_module
 from deepspeed_tpu.utils.logging import log_dist
 
@@ -80,6 +81,21 @@ class InferenceEngine:
         # (forward, the KV-cached decode loops, the paged serving programs)
         # — same contract as the training engine's compile_stats()
         self._telemetry = CompileTelemetry()
+        # unified tracing/metrics plane: serving step phases + per-request
+        # lifecycle spans land here (the PagedServer gets this tracer);
+        # observability() merges it with compile/analysis/serve stats
+        tcfg = self._config.tracing
+        self.tracer = Tracer(max_spans=tcfg.max_spans, enabled=tcfg.enabled)
+        self.metrics = MetricsRegistry()
+        self._obs_hub = ObservabilityHub(self.tracer, self.metrics)
+        self._obs_hub.add_source("compile", self.compile_stats)
+        self._obs_hub.add_source("analysis", self.analysis_report)
+        self._obs_hub.add_source("serve", self.serve_stats)
+        if tcfg.flight_recorder:
+            self._obs_hub.install_flight_recorder(
+                dump_dir=tcfg.flight_recorder_dir,
+                last_spans=tcfg.flight_recorder_spans,
+            )
         self._paged_server = None  # lazy; rebuilt when weights change
         # analysis.verify: static passes on each program at first compile
         if self._config.analysis.verify != "off":
@@ -340,15 +356,17 @@ class InferenceEngine:
     # --- forward --------------------------------------------------------
     def forward(self, *inputs, **kwargs):
         if self.model_profile_enabled:
-            import time as _time
-
-            t0 = _time.perf_counter()
+            # timed through the tracer's clock (DS-R009: no raw
+            # perf_counter in the hot loop) and recorded on the timeline
+            t0 = self.tracer.clock()
             out = self._forward_impl(*inputs, **kwargs)
             # close the async dispatch window: wait on one output element
             leaf = jax.tree_util.tree_leaves(out)[0]
             if hasattr(leaf, "ravel"):
                 jax.device_get(jnp.ravel(leaf)[:1])
-            self._model_times.append(_time.perf_counter() - t0)
+            t1 = self.tracer.clock()
+            self.tracer.add_span("infer.forward", t0, t1)
+            self._model_times.append(t1 - t0)
             return out
         return self._forward_impl(*inputs, **kwargs)
 
@@ -383,14 +401,14 @@ class InferenceEngine:
         signature this function adopts via functools.wraps below)."""
         if not self.model_profile_enabled:
             return self._generate_impl(*args, **kwargs)
-        import time as _time
-
-        t0 = _time.perf_counter()
+        t0 = self.tracer.clock()
         out = self._generate_impl(*args, **kwargs)
         np.asarray(out[..., -1:])  # drain: wait for the last emitted token
         # one entry per generate call (the reference records per-token
         # kernel times; the whole decode is one program here)
-        self._model_times.append(_time.perf_counter() - t0)
+        t1 = self.tracer.clock()
+        self.tracer.add_span("infer.generate", t0, t1)
+        self._model_times.append(t1 - t0)
         return out
 
     def _generate_impl(
@@ -573,6 +591,8 @@ class InferenceEngine:
             prefix_cache=pcfg.prefix_cache,
             ragged=pcfg.ragged,
             journal=journal,
+            tracer=self.tracer,
+            metrics=self.metrics,
         )
         if recovered_states:
             server.recover(recovered_states, next_uid)
@@ -621,6 +641,20 @@ class InferenceEngine:
         if self._paged_server is None:
             return {}
         return self._paged_server.serve_stats()
+
+    def observability(self, analysis: bool = True):
+        """The merged observability report (ISSUE 10), inference side: the
+        serving ``timeline`` (per-step admit/pack/dispatch/emit/journal
+        phases + per-request lifecycle spans) and ``metrics`` next to
+        ``compile`` (``compile_stats()``), ``analysis``
+        (``analysis_report()``; ``analysis=False`` skips its re-compile
+        cost), and ``serve`` (``serve_stats()``). Chrome-trace export and
+        the flight recorder hang off ``engine.observability_hub``."""
+        return self._obs_hub.report(exclude=() if analysis else ("analysis",))
+
+    @property
+    def observability_hub(self):
+        return self._obs_hub
 
     def _zero_generate(self, input_ids, max_new_tokens, eos_token_id, pad_token_id,
                        temperature=0.0, top_k=0, top_p=1.0):

@@ -75,6 +75,7 @@ from deepspeed_tpu.inference.journal import JournaledRequest, RequestJournal
 from deepspeed_tpu.inference.kv_pool import PagePool
 from deepspeed_tpu.inference.spec_decode import Drafter, NGramDrafter
 from deepspeed_tpu.models.config import TransformerConfig
+from deepspeed_tpu.profiling.tracer import NULL_TRACER, MetricsRegistry
 from deepspeed_tpu.utils import chaos
 
 
@@ -152,6 +153,8 @@ class Request:
     done: bool = False
     admissions: int = 0  # > 1 means the request was preempted and resumed
     prefix_cached: int = 0  # context tokens attached from the prefix index
+    spec_drafted: int = 0  # draft tokens this request sent to verification
+    spec_accepted: int = 0  # draft tokens accepted for this request
     t_submit: float = 0.0  # server-clock timestamps for TTFT / TPOT
     t_first: Optional[float] = None
     t_finish: Optional[float] = None
@@ -218,9 +221,18 @@ class PagedServer:
         clock=None,
         ragged: bool = True,
         journal: Optional[RequestJournal] = None,
+        tracer=None,
+        metrics: Optional[MetricsRegistry] = None,
     ):
         self.cfg = cfg
         self.params = params
+        # unified tracing (profiling/tracer.py): per-step phase spans
+        # (admit / pack / dispatch / emit / journal_sync) and per-request
+        # lifecycle spans (submit → admit → first_token → finish, with
+        # tenant / prefix-hit / spec-accept attributes). Host-side only —
+        # the step's device work stays one enqueue + one budgeted fetch.
+        self.tracer = tracer if tracer is not None else NULL_TRACER
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
         self.prefill_chunk = int(prefill_chunk)
         self.attn_impl = attn_impl
         self.telemetry = telemetry
@@ -379,6 +391,9 @@ class PagedServer:
                     t_submit=self.clock())
         )
         self._tenant(tenant)["submitted"] += 1
+        # the request's lifecycle span opens at submit (queue wait included,
+        # matching the TTFT definition) and closes at finish
+        self.tracer.begin_async("request", uid, f"req{uid}", tenant=tenant)
         if self.journal is not None:
             self.journal.append_submit(
                 uid, prompt, int(max_new_tokens), eos_token_id, tenant
@@ -465,18 +480,26 @@ class PagedServer:
         chunks, pending decodes, and drafted verifies together); in
         bucketed mode one prefill dispatch per chunk followed by one
         decode/verify dispatch over the running set."""
-        self._admit()
-        if self.ragged:
-            self._ragged_step()
-        else:
-            self._prefill_step()
-            self._decode_step()
-        # the round's device work and emissions happened; the chaos point
-        # models dying BEFORE the journal flush — the un-synced tokens are
-        # re-derived identically on recovery (greedy re-prefill)
-        chaos.point("serve.mid_step")
-        if self.journal is not None:
-            self.journal.sync()
+        with self.tracer.span("serve.step"):
+            with self.tracer.span("serve.admit"):
+                self._admit()
+            if self.ragged:
+                self._ragged_step()
+            else:
+                with self.tracer.span("serve.prefill"):
+                    self._prefill_step()
+                with self.tracer.span("serve.decode"):
+                    self._decode_step()
+            # the round's device work and emissions happened; the chaos
+            # point models dying BEFORE the journal flush — the un-synced
+            # tokens are re-derived identically on recovery (greedy
+            # re-prefill). A ChaosKilled unwinds through the open spans
+            # (the flight recorder saw them as open at dump time).
+            chaos.point("serve.mid_step")
+            if self.journal is not None:
+                with self.tracer.span("serve.journal_sync"):
+                    self.journal.sync()
+        self.metrics.counter("serve.steps").inc()
 
     def run(self) -> Dict[int, np.ndarray]:
         while self.has_work():
@@ -541,6 +564,10 @@ class PagedServer:
             req.admissions += 1
             self._active.append(req)
             self.stats["admitted"] += 1
+            self.tracer.instant_async(
+                "request", req.uid, "admit",
+                slot=slot, prefix_cached=cached, admissions=req.admissions,
+            )
             self.policy.on_admit(req, self)
 
     def _next_chunk_len(self, req: "Request", ctx_size: int) -> int:
@@ -616,53 +643,64 @@ class PagedServer:
         rows = [r for r in self._active if not r.done]
         if not rows:
             return
-        drafts: Dict[int, np.ndarray] = {}
-        if self.drafter is not None:
-            drafts = self._propose_drafts([r for r in rows if r.pending is not None])
-        chunk_len: Dict[int, int] = {}
-        need: Dict[int, int] = {}
-        for r in rows:
-            if r.pending is None:
-                chunk_len[r.uid] = self._next_chunk_len(r, r.context().size)
-                need[r.uid] = chunk_len[r.uid]
-            else:
-                d = drafts.get(r.uid)
-                if d is None:
-                    d = drafts[r.uid] = np.zeros(0, np.int32)
-                need[r.uid] = d.size + 1
-        rows = self._reserve_for_growth(rows, need)
-        if not rows:
-            return
-        W = (
-            self._ragged_w_mixed
-            if any(r.pending is None for r in rows)
-            else self._ragged_w_decode
-        )
-        # pad to the single fixed row budget — never re-bucketed; lengths
-        # == consumed for prefill rows, so one write base serves every mode
-        R, page_table, lengths = self._dispatch_rows(rows, pad_to=self.pool.max_slots)
-        tokens = np.zeros((R, W), np.int32)
-        q_lens = np.zeros(R, np.int32)
-        for i, r in enumerate(rows):
-            if r.pending is None:
-                real = chunk_len[r.uid]
-                tokens[i, :real] = r.context()[r.consumed : r.consumed + real]
-                q_lens[i] = real
-            else:
-                d = drafts[r.uid]
-                tokens[i, 0] = r.pending
-                tokens[i, 1 : 1 + d.size] = d
-                q_lens[i] = 1 + d.size
-        step_fn = build_ragged_step(
-            self.cfg, R, W, self.pool.page_size, attn_impl=self.attn_impl,
-            telemetry=self.telemetry,
-        )
-        out, new_k, new_v = step_fn(
-            self.params, tokens, self.pool.cache.k_pages, self.pool.cache.v_pages,
-            page_table, lengths, q_lens,
-        )
-        self.pool.set_cache(new_k, new_v)
+        with self.tracer.span("serve.pack") as pack_span:
+            drafts: Dict[int, np.ndarray] = {}
+            if self.drafter is not None:
+                drafts = self._propose_drafts([r for r in rows if r.pending is not None])
+            chunk_len: Dict[int, int] = {}
+            need: Dict[int, int] = {}
+            for r in rows:
+                if r.pending is None:
+                    chunk_len[r.uid] = self._next_chunk_len(r, r.context().size)
+                    need[r.uid] = chunk_len[r.uid]
+                else:
+                    d = drafts.get(r.uid)
+                    if d is None:
+                        d = drafts[r.uid] = np.zeros(0, np.int32)
+                    need[r.uid] = d.size + 1
+            rows = self._reserve_for_growth(rows, need)
+            if not rows:
+                return
+            W = (
+                self._ragged_w_mixed
+                if any(r.pending is None for r in rows)
+                else self._ragged_w_decode
+            )
+            # pad to the single fixed row budget — never re-bucketed; lengths
+            # == consumed for prefill rows, so one write base serves every mode
+            R, page_table, lengths = self._dispatch_rows(rows, pad_to=self.pool.max_slots)
+            tokens = np.zeros((R, W), np.int32)
+            q_lens = np.zeros(R, np.int32)
+            for i, r in enumerate(rows):
+                if r.pending is None:
+                    real = chunk_len[r.uid]
+                    tokens[i, :real] = r.context()[r.consumed : r.consumed + real]
+                    q_lens[i] = real
+                else:
+                    d = drafts[r.uid]
+                    tokens[i, 0] = r.pending
+                    tokens[i, 1 : 1 + d.size] = d
+                    q_lens[i] = 1 + d.size
+            pack_span.set(rows=len(rows), width=W)
+        # dispatch = build + ENQUEUE only (jit returns futures; the fetch
+        # below is where device time surfaces)
+        with self.tracer.span("serve.dispatch", rows=len(rows), width=W):
+            step_fn = build_ragged_step(
+                self.cfg, R, W, self.pool.page_size, attn_impl=self.attn_impl,
+                telemetry=self.telemetry,
+            )
+            out, new_k, new_v = step_fn(
+                self.params, tokens, self.pool.cache.k_pages, self.pool.cache.v_pages,
+                page_table, lengths, q_lens,
+            )
+            self.pool.set_cache(new_k, new_v)
         self.stats["ragged_steps"] += 1
+        with self.tracer.span("serve.emit"):
+            self._settle_ragged_rows(rows, out, chunk_len, q_lens)
+
+    def _settle_ragged_rows(self, rows, out, chunk_len, q_lens) -> None:
+        """Post-dispatch accounting for one ragged step: the budgeted host
+        fetch, then per-row advance/emit/publish."""
         # the step's single host fetch: [R, W+1] = accepted counts + the
         # greedy token after each position
         out = np.asarray(out)  # lint: allow(DS-R005)
@@ -755,6 +793,8 @@ class PagedServer:
         self.pool.rollback(req.slot, d - acc)
         self.stats["spec_drafted"] += d
         self.stats["spec_accepted"] += acc
+        req.spec_drafted += d
+        req.spec_accepted += acc
         if d:
             hist = self.stats["spec_accept_hist"]
             hist[min(acc, len(hist) - 1)] += 1
@@ -862,8 +902,10 @@ class PagedServer:
         ``decode.generate``'s output contract)."""
         if req.t_first is None:
             req.t_first = self.clock()
+            self.tracer.instant_async("request", req.uid, "first_token")
         req.generated.append(token)
         req.pending = token
+        self.metrics.counter("serve.tokens").inc()
         if self.journal is not None:
             self.journal.append_emit(req.uid, token)
         self._tenant(req.tenant)["tokens"] += 1
@@ -890,6 +932,20 @@ class PagedServer:
             tpot_ms = (req.t_finish - req.t_first) * 1e3 / (len(req.generated) - 1)
             ts["tpot_ms"].append(tpot_ms)
         self._finished_log.append((req.tenant, ttft_ms, tpot_ms, len(req.generated)))
+        if self.tracer.enabled:
+            self.tracer.end_async(
+                "request", req.uid, f"req{req.uid}",
+                tenant=req.tenant, tokens=len(req.generated),
+                prefix_cached=req.prefix_cached, admissions=req.admissions,
+                spec_drafted=req.spec_drafted, spec_accepted=req.spec_accepted,
+                ttft_ms=round(ttft_ms, 3),
+                tpot_ms=None if tpot_ms is None else round(tpot_ms, 3),
+            )
+        # the SLA histograms come from the request's clock timestamps, not
+        # the tracer — they record even with tracing disabled
+        self.metrics.histogram("serve.ttft_ms").observe(ttft_ms)
+        if tpot_ms is not None:
+            self.metrics.histogram("serve.tpot_ms").observe(tpot_ms)
         if self.journal is not None:
             self.journal.append_finish(req.uid)
         self.policy.on_finish(req, self)
@@ -966,3 +1022,6 @@ class PagedServer:
         self._active.remove(req)
         self._queue.appendleft(req)
         self.stats["preempted"] += 1
+        self.tracer.instant_async(
+            "request", req.uid, "preempt", tokens=len(req.generated)
+        )

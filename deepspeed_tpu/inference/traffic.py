@@ -244,6 +244,16 @@ class MultiTenantServer:
     def stats(self):
         return self.server.stats
 
+    @property
+    def tracer(self):
+        # the unified tracing plane lives on the wrapped PagedServer (one
+        # timeline per engine); the SLA layer adds no phases of its own
+        return self.server.tracer
+
+    @property
+    def metrics(self):
+        return self.server.metrics
+
     def serve(
         self,
         prompts: Sequence,

@@ -1,0 +1,7 @@
+from deepspeed_tpu.monitor.monitor import (  # noqa: F401
+    JSONLMonitor,
+    MonitorMaster,
+    TensorBoardMonitor,
+    WandbMonitor,
+    csvMonitor,
+)

@@ -1,12 +1,27 @@
 """Monitoring backends.
 
 Counterpart of ``deepspeed/monitor/`` (``MonitorMaster`` monitor.py:29 fanning
-out ``write_events`` to TensorBoard / W&B / CSV).
+out ``write_events`` to TensorBoard / W&B / CSV), wired to the unified
+observability plane (ISSUE 10):
+
+* every backend consumes the same ``(name, value, step)`` event tuples;
+* :class:`JSONLMonitor` is the torch-free, always-available backend — one
+  JSON line per event in an append-only file (torn tails are harmless to
+  line-wise readers) — and is **default-ON at rank 0** whenever the
+  ``monitor`` config block's master switch is set;
+* TensorBoard / W&B stay optional imports that degrade to disabled with a
+  warning, exactly as before;
+* :class:`MonitorMaster` fans one ``write_events`` call out to every
+  enabled backend. The training engine feeds it the loss/lr events plus
+  the observability hub's periodic metric events
+  (``ObservabilityHub.monitor_events``) on the configured cadence.
 """
 
 from __future__ import annotations
 
+import json
 import os
+import time
 from typing import List, Tuple
 
 from deepspeed_tpu.comm import comm as dist
@@ -93,15 +108,66 @@ class csvMonitor(Monitor):
                 w.writerow([step, value])
 
 
+class JSONLMonitor(Monitor):
+    """Torch-free structured backend: every event is one JSON line
+    (``{"name", "value", "step", "t"}``) appended to
+    ``output_path/job_name/events.jsonl``. Append-mode by design — a kill
+    mid-write tears at most the last line, which line-wise readers skip.
+    ``force`` bypasses the master-switch gate (tests / direct use)."""
+
+    def __init__(self, jsonl_config, master_enabled: bool = True, force: bool = False):
+        super().__init__(jsonl_config)
+        self.enabled = (
+            jsonl_config.enabled
+            and (master_enabled or force)
+            and dist.get_rank() == 0
+        )
+        self.output_path = jsonl_config.output_path or "./ds_monitor"
+        self.job_name = jsonl_config.job_name
+        self._path = os.path.join(self.output_path, self.job_name, "events.jsonl")
+        if self.enabled:
+            os.makedirs(os.path.dirname(self._path), exist_ok=True)
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+    def write_events(self, event_list) -> None:
+        if not self.enabled:
+            return
+        now = time.time()
+        with open(self._path, "a", encoding="utf-8") as f:
+            for name, value, step in event_list:
+                f.write(
+                    json.dumps(
+                        {"name": name, "value": float(value), "step": int(step), "t": now}
+                    )
+                    + "\n"
+                )
+
+
 class MonitorMaster(Monitor):
+    """Fanout over every enabled backend (reference monitor.py:29). The
+    JSONL backend activates with the ``monitor`` block's master switch;
+    TensorBoard / W&B / CSV follow their own enabled flags (legacy
+    top-level keys keep working)."""
+
     def __init__(self, monitor_config):
         super().__init__(monitor_config)
+        master_on = bool(getattr(monitor_config, "enabled", False))
+        self.jsonl_monitor = JSONLMonitor(monitor_config.jsonl, master_enabled=master_on)
         self.tb_monitor = TensorBoardMonitor(monitor_config.tensorboard)
         self.wandb_monitor = WandbMonitor(monitor_config.wandb)
         self.csv_monitor = csvMonitor(monitor_config.csv_monitor)
-        self.enabled = self.tb_monitor.enabled or self.wandb_monitor.enabled or self.csv_monitor.enabled
+        self.backends = [
+            self.jsonl_monitor,
+            self.tb_monitor,
+            self.wandb_monitor,
+            self.csv_monitor,
+        ]
+        self.enabled = any(m.enabled for m in self.backends)
 
     def write_events(self, event_list) -> None:
-        for m in (self.tb_monitor, self.wandb_monitor, self.csv_monitor):
+        for m in self.backends:
             if m.enabled:
                 m.write_events(event_list)

@@ -119,11 +119,18 @@ class _Job:
 class AsyncCheckpointWriter:
     """Background persister over a (staged, atomic) checkpoint engine."""
 
-    def __init__(self, inner, max_inflight: int = 2):
+    def __init__(self, inner, max_inflight: int = 2, tracer=None):
         if max_inflight < 1:
             raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
         self.inner = inner
         self.max_inflight = int(max_inflight)
+        # unified-tracing hookup: the writer thread records ckpt.stage /
+        # ckpt.commit spans onto the ENGINE's tracer — the tracer's ring
+        # buffer and nesting state are thread-safe by contract (the tracer
+        # test suite exercises exactly this writer)
+        from deepspeed_tpu.profiling.tracer import NULL_TRACER
+
+        self.tracer = tracer if tracer is not None else NULL_TRACER
         self._jobs: deque = deque()
         self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
@@ -208,10 +215,12 @@ class AsyncCheckpointWriter:
                 job = self._jobs[0]
             try:
                 t0 = time.perf_counter()
-                self.inner.save(job.state, job.path)
-                self.inner.commit(job.tag)
-                if job.save_dir is not None:
-                    write_latest_marker(job.save_dir, job.tag)
+                with self.tracer.span("ckpt.stage", tag=job.tag):
+                    self.inner.save(job.state, job.path)
+                with self.tracer.span("ckpt.commit", tag=job.tag):
+                    self.inner.commit(job.tag)
+                    if job.save_dir is not None:
+                        write_latest_marker(job.save_dir, job.tag)
                 self.last_save_s = time.perf_counter() - t0
                 self.saves += 1
             except Exception as e:  # surfaced at the next fence

@@ -13,7 +13,7 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, List, Optional, Union
 
-from pydantic import Field, field_validator
+from pydantic import Field, field_validator, model_validator
 
 from deepspeed_tpu.runtime import constants as C
 from deepspeed_tpu.runtime.config_utils import (
@@ -176,6 +176,34 @@ class AnalysisConfig(DeepSpeedConfigModel):
         return v
 
 
+class TracingConfig(DeepSpeedConfigModel):
+    """Unified tracing/metrics plane (``profiling/tracer.py``; ISSUE 10).
+
+    ``enabled`` (default ON — the tracer is host-side only, adds zero
+    device transfers and zero compiled programs, and measures under 2%
+    of a bench step) records step-phase spans and engine metrics into a
+    ``max_spans``-deep ring buffer, readable via ``engine.observability()``
+    and exportable as a Perfetto/Chrome trace. ``flight_recorder`` arms the
+    crash postmortem: on interpreter exit and on every ``utils/chaos.py``
+    fault injection the last ``flight_recorder_spans`` spans + a metrics
+    snapshot are dumped to ``flight_recorder_dir`` (required when armed)."""
+
+    enabled: bool = True
+    max_spans: int = 4096
+    flight_recorder: bool = False
+    flight_recorder_dir: Optional[str] = None
+    flight_recorder_spans: int = 256
+
+    @model_validator(mode="after")
+    def _check_recorder(self):
+        if self.flight_recorder and not self.flight_recorder_dir:
+            raise ValueError(
+                "tracing.flight_recorder requires tracing.flight_recorder_dir "
+                "(the postmortem dump target)"
+            )
+        return self
+
+
 class CommsLoggerConfig(DeepSpeedConfigModel):
     enabled: bool = False
     verbose: bool = False
@@ -232,14 +260,45 @@ class CSVConfig(DeepSpeedConfigModel):
     job_name: str = "DeepSpeedJobName"
 
 
+class JSONLConfig(DeepSpeedConfigModel):
+    """The torch-free always-available monitor backend: one JSON line per
+    event under ``output_path/job_name/events.jsonl`` (append-only — torn
+    tails are tolerated by line-wise readers). Default-ON whenever the
+    ``monitor`` block is enabled; rank-0 gated like every backend."""
+
+    enabled: bool = True
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
 class MonitorConfig(DeepSpeedConfigModel):
+    """The ``monitor`` config block (reference ``deepspeed/monitor/config.py``
+    + ``monitor.py:29`` MonitorMaster fanout).
+
+    ``enabled`` is the master switch: it turns on the torch-free JSONL
+    backend (rank 0) by default and lets the engine feed periodic metric
+    events from the observability hub every ``interval_steps`` optimizer
+    steps (0 = the ``steps_per_print`` cadence). TensorBoard / W&B / CSV
+    remain individually opt-in (optional imports, degrade to disabled) and
+    keep working from their legacy top-level config keys."""
+
+    enabled: bool = False
+    interval_steps: int = 0
+    jsonl: JSONLConfig = Field(default_factory=JSONLConfig)
     tensorboard: TensorBoardConfig = Field(default_factory=TensorBoardConfig)
     wandb: WandbConfig = Field(default_factory=WandbConfig)
     csv_monitor: CSVConfig = Field(default_factory=CSVConfig)
 
     @property
-    def enabled(self) -> bool:
-        return self.tensorboard.enabled or self.wandb.enabled or self.csv_monitor.enabled
+    def active(self) -> bool:
+        """Any path that produces events: the master switch (JSONL default)
+        or a legacy individually-enabled backend."""
+        return (
+            self.enabled
+            or self.tensorboard.enabled
+            or self.wandb.enabled
+            or self.csv_monitor.enabled
+        )
 
 
 class CheckpointConfig(DeepSpeedConfigModel):
@@ -399,11 +458,18 @@ class DeepSpeedConfig:
             **get("activation_checkpointing", {})
         )
         self.flops_profiler_config = FlopsProfilerConfig(**get("flops_profiler", {}))
-        self.monitor_config = MonitorConfig(
-            tensorboard=get("tensorboard", {}),
-            wandb=get("wandb", {}),
-            csv_monitor=get("csv_monitor", {}),
-        )
+        self.tracing_config = TracingConfig(**get("tracing", {}))
+        # the `monitor` block is canonical (validated whole by pydantic, so
+        # a typo'd key fails loudly like every other block); the legacy
+        # top-level tensorboard/wandb/csv_monitor keys keep working
+        # underneath it, and `csv` aliases `csv_monitor` inside the block
+        mon = dict(get("monitor", {}) or {})
+        if "csv" in mon:
+            mon["csv_monitor"] = mon.pop("csv")
+        mon.setdefault("tensorboard", get("tensorboard", {}))
+        mon.setdefault("wandb", get("wandb", {}))
+        mon.setdefault("csv_monitor", get("csv_monitor", {}))
+        self.monitor_config = MonitorConfig(**mon)
         self.checkpoint_config = CheckpointConfig(**get(C.CHECKPOINT, {}))
         self.data_types_config = DataTypesConfig(**get(C.DATA_TYPES, {}))
         self.hybrid_engine = HybridEngineConfig(**get("hybrid_engine", {}))

@@ -54,6 +54,7 @@ from deepspeed_tpu.profiling.compile_telemetry import (
     CompileTelemetry,
     configure_persistent_cache,
 )
+from deepspeed_tpu.profiling.tracer import MetricsRegistry, ObservabilityHub, Tracer
 from deepspeed_tpu.runtime import constants as C
 from deepspeed_tpu.runtime.checkpoint_engine.atomic import (
     CheckpointCorruptError,
@@ -228,9 +229,32 @@ class DeepSpeedEngine:
         self._in_forward = False
         self._training_mode = True
 
+        # unified tracing & metrics plane (profiling/tracer.py) -----------
+        # host-side spans around every step-loop phase + a metrics registry,
+        # merged with the compile/analysis/checkpoint surfaces by
+        # observability(). Tracing is pure host bookkeeping: zero device
+        # transfers, zero compiled programs (guarded by tests).
+        tcfg = self._config.tracing_config
+        self.tracer = Tracer(max_spans=tcfg.max_spans, enabled=tcfg.enabled)
+        self.metrics = MetricsRegistry()
+        self._obs_hub = ObservabilityHub(self.tracer, self.metrics)
+        self._obs_hub.add_source("compile", self.compile_stats)
+        self._obs_hub.add_source("analysis", self.analysis_report)
+        self._obs_hub.add_source("checkpoint", self.checkpoint_stats)
+        if tcfg.flight_recorder:
+            self._obs_hub.install_flight_recorder(
+                dump_dir=tcfg.flight_recorder_dir,
+                last_spans=tcfg.flight_recorder_spans,
+            )
+        dist.set_comm_tracer(self.tracer)
+
         # timers ---------------------------------------------------------
         self.wall_clock_breakdown = self._config.wall_clock_breakdown
-        self.timers = SynchronizedWallClockTimer() if self.wall_clock_breakdown else NoopTimer()
+        self.timers = (
+            SynchronizedWallClockTimer(tracer=self.tracer)
+            if self.wall_clock_breakdown
+            else NoopTimer()
+        )
         self.tput_timer = ThroughputTimer(
             batch_size=self.train_batch_size(),
             steps_per_output=self._config.steps_per_print,
@@ -327,7 +351,7 @@ class DeepSpeedEngine:
 
         # monitor --------------------------------------------------------
         self.monitor = None
-        if self._config.monitor_config.enabled:
+        if self._config.monitor_config.active:
             from deepspeed_tpu.monitor.monitor import MonitorMaster
 
             self.monitor = MonitorMaster(self._config.monitor_config)
@@ -1458,7 +1482,8 @@ class DeepSpeedEngine:
         if self.curriculum_scheduler is not None and self._training_mode:
             seqlen = self.curriculum_scheduler.update_difficulty(self.global_steps + 1)
             batch = _truncate_seq(batch, seqlen)
-        placed = self._place_batch(batch)
+        with self.tracer.span("train.h2d"):
+            placed = self._place_batch(batch)
         if self._param_stream is not None:
             loss = self._stream_forward(placed)
             self.timers(FORWARD_GLOBAL_TIMER).stop(sync=False)
@@ -1508,7 +1533,10 @@ class DeepSpeedEngine:
                     fwd_args,
                 )
                 self._profile_fn = self._jit_fused_step
-            out = self._jit_fused_step(*fwd_args)
+            # dispatch ENQUEUE only: jit returns futures; device time shows
+            # up at the next blocking fetch, never as a sync here
+            with self.tracer.span("train.dispatch", program="fused_step"):
+                out = self._jit_fused_step(*fwd_args)
             # the inputs were donated — adopt the new state immediately so the
             # engine never holds references to deleted buffers
             if self.mixed_precision:
@@ -1557,11 +1585,15 @@ class DeepSpeedEngine:
                     fwd_args,
                 )
                 self._profile_fn = self._jit_fwd_bwd
-            loss, self._grad_acc = self._jit_fwd_bwd(*fwd_args)
+            # one grad-accum microstep (fwd+bwd+accumulate enqueue)
+            micro_idx = self.micro_steps % self.gradient_accumulation_steps()
+            with self.tracer.span("train.microstep", micro=micro_idx):
+                loss, self._grad_acc = self._jit_fwd_bwd(*fwd_args)
             self._last_loss = loss
             self._in_forward = True
         else:
-            loss = self._jit_eval(self._params, step_rng, placed)
+            with self.tracer.span("eval.dispatch"):
+                loss = self._jit_eval(self._params, step_rng, placed)
             self._last_loss = loss
         if profiling:
             jax.device_get(loss)  # close the latency window at step end
@@ -1636,7 +1668,11 @@ class DeepSpeedEngine:
         self.timers(STEP_GLOBAL_TIMER).start()
         boundary = self.is_gradient_accumulation_boundary()
         if boundary:
-            self._take_model_step()
+            # counted BEFORE the commit so the monitor feed (which runs in
+            # the commit's bookkeeping tail) reports this step inclusively
+            self.metrics.counter("train.steps").inc()
+            with self.tracer.span("train.step_commit"):
+                self._take_model_step()
         self.micro_steps += 1
         self.global_samples += self.train_micro_batch_size_per_gpu() * self.data_parallel_world_size()
         self.timers(STEP_GLOBAL_TIMER).stop(sync=False)
@@ -1846,8 +1882,13 @@ class DeepSpeedEngine:
         ccfg = self._config.checkpoint_config
         if ccfg.save_dir and ccfg.interval_steps > 0 and self.global_steps % ccfg.interval_steps == 0:
             self.save_checkpoint(ccfg.save_dir)
-        if self.monitor is not None and self.global_steps % self._config.steps_per_print == 0:
-            self._write_monitor()
+        if self.monitor is not None:
+            interval = (
+                self._config.monitor_config.interval_steps
+                or self._config.steps_per_print
+            )
+            if self.global_steps % interval == 0:
+                self._write_monitor()
 
     def _take_model_step(self) -> None:
         if self._fused_step_enabled:
@@ -1908,7 +1949,27 @@ class DeepSpeedEngine:
         totals = self._telemetry.totals()
         events.append(("Train/Samples/compile_count", float(totals["compiles"]), self.global_samples))
         events.append(("Train/Samples/compile_seconds", float(totals["compile_seconds"]), self.global_samples))
+        # periodic metric feed from the observability hub: step-phase means
+        # off the timeline plus every registered counter/gauge/histogram
+        events.extend(self._obs_hub.monitor_events(self.global_samples))
         self.monitor.write_events(events)
+
+    def observability(self, analysis: bool = True) -> Dict[str, Any]:
+        """The merged observability report (ISSUE 10): the live step-phase
+        ``timeline`` (span counts, per-phase ms aggregates, ring-buffer
+        state) and ``metrics`` (counters/gauges/histograms incl. p50/p99)
+        next to the engine's existing surfaces — ``compile``
+        (``compile_stats()``), ``analysis`` (``analysis_report()``; pass
+        ``analysis=False`` to skip its re-trace/re-compile cost), and
+        ``checkpoint`` (``checkpoint_stats()``). The hub behind it also
+        exports the timeline as a Perfetto/Chrome trace
+        (``engine.observability_hub.export_chrome_trace(path)``) and owns
+        the crash flight recorder (``tracing.flight_recorder``)."""
+        return self._obs_hub.report(exclude=() if analysis else ("analysis",))
+
+    @property
+    def observability_hub(self) -> ObservabilityHub:
+        return self._obs_hub
 
     def compile_stats(self) -> Dict[str, Dict[str, Any]]:
         """Per-program compile telemetry snapshot: for each jitted program
@@ -1955,7 +2016,8 @@ class DeepSpeedEngine:
         if batch is not None:
             micro = self._split_step_batch(batch, gas)
         else:
-            micro = [next(data_iter) for _ in range(gas)]
+            with self.tracer.span("train.data_fetch", gas=gas):
+                micro = [next(data_iter) for _ in range(gas)]
         if not self._initialized:
             self.init_params(micro[0])
         if (
@@ -1981,7 +2043,10 @@ class DeepSpeedEngine:
             losses.append(loss)
         # one batched fetch, not gas sequential round-trips (each
         # device_get is a blocking host RTT on the tunneled backend)
-        vals = jax.device_get(losses)
+        with self.tracer.span("train.loss_fetch") as sp:
+            vals = jax.device_get(losses)
+        if self.tracer.enabled:
+            self.metrics.histogram("train.loss_fetch_ms").observe(sp.duration_ms)
         return sum(vals) / len(vals)
 
     def _fused_train_batch(self, micro):
@@ -1995,18 +2060,22 @@ class DeepSpeedEngine:
         gas = self.gradient_accumulation_steps()
         self.tput_timer.start()
         self.timers(FORWARD_GLOBAL_TIMER).start()
+        t_step0 = self.tracer.clock()
         if self.curriculum_scheduler is not None:
             seqlen = self.curriculum_scheduler.update_difficulty(self.global_steps + 1)
             micro = [_truncate_seq(b, seqlen) for b in micro]
-        stacked = self._place_stacked_batch(micro)
+        with self.tracer.span("train.h2d"):
+            stacked = self._place_stacked_batch(micro)
         model_kwargs = self._model_kwargs()  # pld theta; random-LTD is gated off
         parent_rng = self._rng
         lr = self.optimizer.param_groups[0]["lr"]
+        dispatch_span = self.tracer.span("train.dispatch", program="fused_accum_step")
         if self.mixed_precision:
-            out = self._jit_fused_accum_step(
-                self._params, self._master, self._opt_state, self._scale_state,
-                lr, self._rng, stacked, model_kwargs,
-            )
+            with dispatch_span:
+                out = self._jit_fused_accum_step(
+                    self._params, self._master, self._opt_state, self._scale_state,
+                    lr, self._rng, stacked, model_kwargs,
+                )
             (
                 loss,
                 self._params,
@@ -2019,10 +2088,11 @@ class DeepSpeedEngine:
                 self._rng,
             ) = out
         else:
-            out = self._jit_fused_accum_step(
-                self._master, self._opt_state, self._scale_state,
-                lr, self._rng, stacked, model_kwargs,
-            )
+            with dispatch_span:
+                out = self._jit_fused_accum_step(
+                    self._master, self._opt_state, self._scale_state,
+                    lr, self._rng, stacked, model_kwargs,
+                )
             (
                 loss,
                 self._master,
@@ -2054,10 +2124,20 @@ class DeepSpeedEngine:
         self.global_samples += (
             self.train_micro_batch_size_per_gpu() * self.data_parallel_world_size() * gas
         )
+        self.metrics.counter("train.steps").inc()
         self._finish_step_bookkeeping(overflow_flag)
         self.timers(STEP_GLOBAL_TIMER).stop(sync=False)
         self.tput_timer.stop(global_step=True)
-        return jax.device_get(loss)
+        with self.tracer.span("train.loss_fetch"):
+            val = jax.device_get(loss)
+        if self.tracer.enabled:
+            # the whole fused optimizer step, host-side wall clock (the
+            # loss fetch closes the window — the one sanctioned blocking
+            # read, so this includes the device time the dispatch hid)
+            t_now = self.tracer.clock()
+            self.tracer.add_span("train.step", t_step0, t_now, gas=gas, fused=True)
+            self.metrics.histogram("train.step_ms").observe((t_now - t_step0) * 1e3)
+        return val
 
     def _split_step_batch(self, batch, gas: int):
         """Slice a full-step batch into gas microbatches along the leading dim."""
@@ -2193,12 +2273,16 @@ class DeepSpeedEngine:
                 self._ckpt_writer = AsyncCheckpointWriter(
                     self.checkpoint_engine,
                     max_inflight=self._config.checkpoint_config.max_inflight_snapshots,
+                    tracer=self.tracer,
                 )
             # the ONLY on-step cost: device->host of the state tuple. It
             # must complete before returning — the step programs donate
             # these buffers, so the next dispatch invalidates them.
-            host_state = host_snapshot(state)
+            with self.tracer.span("ckpt.d2h_stall", tag=tag):
+                host_state = host_snapshot(state)
             stall_ms = (time.perf_counter() - t0) * 1e3
+            if self.tracer.enabled:
+                self.metrics.histogram("ckpt.stall_ms").observe(stall_ms)
             self._ckpt_writer.submit(
                 host_state, path, tag, save_dir if update_latest else None
             )

@@ -118,6 +118,15 @@ class ChaosSchedule:
             self._act(rule, ctx)
 
     def _act(self, rule: ChaosRule, ctx: Dict) -> None:
+        # pre-death hooks (the flight recorder): run BEFORE the action so a
+        # postmortem dump exists even for the real os._exit, which skips
+        # every atexit/finally downstream. Hook failures never save the
+        # process — the kill proceeds regardless.
+        for hook in list(_KILL_HOOKS):
+            try:
+                hook(rule.point, rule.action)
+            except Exception:
+                pass
         if rule.action == "exit":
             os._exit(137)  # the real thing: no atexit, no flushing
         if rule.action in ("truncate", "corrupt"):
@@ -140,6 +149,22 @@ class ChaosSchedule:
 
 
 _SCHEDULE: Optional[ChaosSchedule] = None
+
+# Pre-death hooks: callables ``(point, action) -> None`` run right before a
+# rule's action executes (before the ChaosKilled raise AND before the real
+# os._exit). The flight recorder (profiling/tracer.py) registers here so
+# every injected kill leaves a postmortem file naming the armed point.
+_KILL_HOOKS: List = []
+
+
+def add_kill_hook(fn) -> None:
+    if fn not in _KILL_HOOKS:
+        _KILL_HOOKS.append(fn)
+
+
+def remove_kill_hook(fn) -> None:
+    if fn in _KILL_HOOKS:
+        _KILL_HOOKS.remove(fn)
 
 
 def install(schedule: ChaosSchedule) -> ChaosSchedule:

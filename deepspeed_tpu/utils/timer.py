@@ -22,9 +22,21 @@ from deepspeed_tpu.utils.sync import device_sync as _sync
 
 
 class SynchronizedWallClockTimer:
+    """Named host timers. ``tracer`` (``profiling/tracer.py``) routes every
+    completed start/stop interval into the unified timeline as a span, so
+    the wall-clock breakdown and the trace are one dataset.
+
+    HOT-PATH HAZARD (fixed): ``Timer.stop`` used to default ``sync=True`` —
+    a full device sync (drain of the async dispatch queue) on every stop,
+    which on a tunneled TPU backend serializes host and device and can
+    dominate the step time. The default is now ``sync=False``; pass
+    ``sync=True`` explicitly only OUTSIDE the step loop (window boundaries,
+    benches — ``ThroughputTimer`` below is the sanctioned synced timer)."""
+
     class Timer:
-        def __init__(self, name: str):
+        def __init__(self, name: str, tracer=None):
             self.name = name
+            self.tracer = tracer
             self.started = False
             self.start_time = 0.0
             self.elapsed_ = 0.0
@@ -36,15 +48,18 @@ class SynchronizedWallClockTimer:
             self.start_time = time.perf_counter()
             self.started = True
 
-        def stop(self, sync: bool = True, record: bool = False):
+        def stop(self, sync: bool = False, record: bool = False):
             if not self.started:
                 return
             if sync:
                 _sync()
-            self.elapsed_ += time.perf_counter() - self.start_time
+            now = time.perf_counter()
+            self.elapsed_ += now - self.start_time
             self.started = False
             if record:
                 self.record.append(self.elapsed_)
+            if self.tracer is not None:
+                self.tracer.add_span(f"timer.{self.name}", self.start_time, now)
 
         def reset(self):
             self.elapsed_ = 0.0
@@ -59,12 +74,13 @@ class SynchronizedWallClockTimer:
         def mean(self) -> float:
             return sum(self.record) / len(self.record) if self.record else 0.0
 
-    def __init__(self):
+    def __init__(self, tracer=None):
         self.timers: Dict[str, SynchronizedWallClockTimer.Timer] = {}
+        self.tracer = tracer
 
     def __call__(self, name: str) -> "SynchronizedWallClockTimer.Timer":
         if name not in self.timers:
-            self.timers[name] = self.Timer(name)
+            self.timers[name] = self.Timer(name, tracer=self.tracer)
         return self.timers[name]
 
     def log(self, names: List[str], normalizer: float = 1.0, reset: bool = True, memory_breakdown=None, ranks=None):  # noqa: ARG002

@@ -373,6 +373,77 @@ def test_r008_bench_record_paths_in_scope():
     ]
 
 
+def test_r009_raw_clock_in_step_loop_flagged():
+    """A raw perf_counter (or time.time / device_sync) inside a step-loop
+    method of an Engine/Server/Scheduler class forks a second timeline
+    next to the unified tracer — red."""
+    findings = _rules("""
+        import time
+        class FooServer:
+            def step(self):
+                t0 = time.perf_counter()
+                return t0
+        class BarEngine:
+            def train_batch(self):
+                return time.time()
+        class BazScheduler:
+            def _ragged_step(self):
+                device_sync()
+    """)
+    assert findings.count("DS-R009") == 3
+
+
+def test_r009_quiet_outside_scope():
+    """Out of scope: non-step methods, non-engine classes, injected clocks,
+    and the tracer/timer modules themselves (path exemption)."""
+    assert "DS-R009" not in _rules("""
+        import time
+        class FooServer:
+            def __init__(self, clock=None):
+                self.clock = clock or time.perf_counter  # reference, not a call
+            def save_checkpoint(self):
+                return time.perf_counter()  # not a step-loop method
+            def step(self):
+                return self.clock()  # injected clock is the sanctioned idiom
+        class Helper:
+            def step(self):
+                return time.perf_counter()  # not an Engine/Server/Scheduler
+    """)
+    src = """
+        import time
+        class FooServer:
+            def step(self):
+                return time.perf_counter()
+    """
+    import textwrap as _tw
+
+    assert [
+        f.rule for f in lint_source(_tw.dedent(src), path="deepspeed_tpu/utils/timer.py")
+    ] == []
+    assert [
+        f.rule for f in lint_source(_tw.dedent(src), path="deepspeed_tpu/profiling/tracer.py")
+    ] == []
+    assert "DS-R009" in [
+        f.rule for f in lint_source(_tw.dedent(src), path="deepspeed_tpu/inference/scheduler.py")
+    ]
+
+
+def test_r009_pragma_suppresses_and_is_error_severity():
+    src = """
+        import time
+        class FooServer:
+            def step(self):
+                return time.perf_counter()  # lint: allow(DS-R009)
+    """
+    assert "DS-R009" not in _rules(src)
+    f = lint_source(
+        textwrap.dedent(src.replace("  # lint: allow(DS-R009)", "")),
+        path="deepspeed_tpu/x.py",
+    )[0]
+    assert f.rule == "DS-R009"
+    assert resolve_severity(f) == "error"
+
+
 def test_severity_tests_path_is_warn_only():
     f = lint_source("import jax.numpy as jnp\nx = jnp.repeat(k_cache, 2)\n", path="tests/unit/foo.py")[0]
     assert f.rule == "DS-R001"

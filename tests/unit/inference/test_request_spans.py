@@ -1,0 +1,244 @@
+"""Serving request-span lifecycle tests (ISSUE 10).
+
+The PagedServer records per-step phase spans (admit / pack / dispatch /
+emit / journal_sync) and per-request lifecycle spans (submit → admit →
+first_token → finish, with preempt instants and tenant / prefix-hit /
+spec-accept attributes) onto the engine's tracer. These tests drive the
+real scheduler across admission, preemption, and speculative decoding and
+assert the timeline tells the true story — plus the engine-surface
+``observability()`` merge and the Perfetto trace export for a serving
+run."""
+
+from __future__ import annotations
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.inference.scheduler import PagedServer
+from deepspeed_tpu.models import TransformerLM
+from deepspeed_tpu.models.config import TransformerConfig
+from deepspeed_tpu.profiling.tracer import MetricsRegistry, Tracer
+
+CFG = dict(
+    vocab_size=128,
+    hidden_size=64,
+    num_layers=2,
+    num_heads=4,
+    num_kv_heads=2,
+    max_seq_len=64,
+    norm="rmsnorm",
+    position="rope",
+    activation="swiglu",
+    use_bias=False,
+    tie_embeddings=False,
+    flash_attention=False,
+    dtype="float32",
+)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    cfg = TransformerConfig(**CFG)
+    model = TransformerLM(cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0, cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(0), toks)
+    return cfg, model, params
+
+
+def _server(cfg, params, tracer=None, metrics=None, **kw):
+    kw.setdefault("page_size", 8)
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("prefill_chunk", 8)
+    kw.setdefault("attn_impl", "xla")
+    kw.setdefault("dtype", jnp.float32)
+    return PagedServer(cfg, params, tracer=tracer, metrics=metrics, **kw)
+
+
+def _lifecycle(tracer, uid):
+    """(ph, name) sequence of the async records for one request uid."""
+    return [
+        (r["ph"], r["name"])
+        for r in tracer.spans()
+        if r["ph"] in ("b", "n", "e") and r.get("id") == uid
+    ]
+
+
+def _prompts(n, seed=0, lo=3, hi=20):
+    rs = np.random.RandomState(seed)
+    return [
+        rs.randint(0, CFG["vocab_size"], (int(rs.randint(lo, hi)),)).astype(np.int32)
+        for _ in range(n)
+    ]
+
+
+def test_request_lifecycle_submit_admit_first_token_finish(model_and_params):
+    cfg, _, params = model_and_params
+    tr, m = Tracer(), MetricsRegistry()
+    server = _server(cfg, params, tracer=tr, metrics=m)
+    uids = [server.submit(p, max_new_tokens=6, tenant="acme") for p in _prompts(3)]
+    server.run()
+    for uid in uids:
+        names = _lifecycle(tr, uid)
+        assert names[0] == ("b", f"req{uid}")
+        assert ("n", "admit") in names
+        assert ("n", "first_token") in names
+        assert names[-1] == ("e", f"req{uid}")
+        # chronology: admit before first_token before finish
+        assert names.index(("n", "admit")) < names.index(("n", "first_token"))
+    # finish attrs carry the serving story
+    end = [r for r in tr.spans() if r["ph"] == "e" and r.get("id") == uids[0]][0]
+    assert end["attrs"]["tenant"] == "acme"
+    assert end["attrs"]["tokens"] == 6
+    assert end["attrs"]["admissions"] == 1
+    assert end["attrs"]["ttft_ms"] >= 0.0
+    # step phases + metrics observed
+    phases = tr.phase_summary()
+    for name in ("serve.step", "serve.admit", "serve.pack", "serve.dispatch", "serve.emit"):
+        assert phases[name]["count"] >= 1, name
+    assert m.snapshot()["counters"]["serve.tokens"] == 18.0
+    assert m.snapshot()["histograms"]["serve.ttft_ms"]["count"] == 3
+
+
+def test_preemption_leaves_preempt_instant_and_readmission(model_and_params):
+    """A pool sized to force recompute-preemption: the victim's span trail
+    shows preempt → admit again, and its finish attrs count both
+    admissions. Output correctness is covered by the serving suites; here
+    the TIMELINE is the contract."""
+    cfg, _, params = model_and_params
+    tr = Tracer()
+    server = _server(cfg, params, tracer=tr, num_pages=7, max_slots=2)
+    uids = [server.submit(p, max_new_tokens=16) for p in _prompts(2, seed=3, lo=10, hi=14)]
+    server.run()
+    assert server.stats["preempted"] >= 1
+    preempted = [
+        r.get("id") for r in tr.spans() if r["ph"] == "n" and r["name"] == "preempt"
+    ]
+    assert preempted, "no preempt instant recorded"
+    uid = preempted[0]
+    names = _lifecycle(tr, uid)
+    i_pre = names.index(("n", "preempt"))
+    assert ("n", "admit") in names[i_pre:], "no re-admission after preempt"
+    end = [r for r in tr.spans() if r["ph"] == "e" and r.get("id") == uid][0]
+    assert end["attrs"]["admissions"] >= 2
+
+
+def test_spec_decode_attrs_on_finish(model_and_params):
+    """With the n-gram drafter engaged on a motif prompt, the request's
+    finish span reports how many drafts it sent and how many were
+    accepted (the per-request speculation story)."""
+    cfg, _, params = model_and_params
+    tr = Tracer()
+    server = _server(
+        cfg, params,
+        spec_decode={"enable": True, "max_draft": 3, "ngram_order": 2},
+    )
+    server.tracer = tr
+    motif = np.array([5, 9, 5, 9, 5, 9, 5, 9, 5, 9], np.int32)
+    uid = server.submit(motif, max_new_tokens=8)
+    server.run()
+    assert server.stats["spec_drafted"] > 0  # the drafter engaged
+    end = [r for r in tr.spans() if r["ph"] == "e" and r.get("id") == uid][0]
+    assert end["attrs"]["spec_drafted"] == server.stats["spec_drafted"]
+    assert end["attrs"]["spec_accepted"] == server.stats["spec_accepted"]
+
+
+def test_journal_sync_phase_present(model_and_params, tmp_path):
+    from deepspeed_tpu.inference.journal import RequestJournal
+
+    cfg, _, params = model_and_params
+    tr = Tracer()
+    journal = RequestJournal(str(tmp_path / "j"))
+    server = _server(cfg, params, tracer=tr, journal=journal)
+    server.serve(_prompts(2, seed=5), max_new_tokens=4)
+    assert tr.phase_summary()["serve.journal_sync"]["count"] >= 1
+
+
+def test_prefix_cached_attr_rides_admit_event(model_and_params):
+    """Second serve of a shared prompt attaches cached full pages; the
+    admit instant reports how many context tokens the request did NOT
+    re-prefill."""
+    cfg, _, params = model_and_params
+    tr = Tracer()
+    server = _server(cfg, params, tracer=tr, prefix_cache=True)
+    prompt = np.arange(1, 25, dtype=np.int32) % CFG["vocab_size"]
+    server.serve([prompt], max_new_tokens=2)
+    uid2 = server.submit(prompt, max_new_tokens=2)
+    server.run()
+    admit2 = [
+        r for r in tr.spans()
+        if r["ph"] == "n" and r["name"] == "admit" and r.get("id") == uid2
+    ][0]
+    assert admit2["attrs"]["prefix_cached"] > 0
+    end = [r for r in tr.spans() if r["ph"] == "e" and r.get("id") == uid2][0]
+    assert end["attrs"]["prefix_cached"] == admit2["attrs"]["prefix_cached"]
+
+
+def test_engine_observability_merged_report_and_trace(model_and_params, tmp_path):
+    """The acceptance surface: ONE observability() call returns the merged
+    report (timeline + metrics + compile + analysis + serve stats), and
+    the hub exports a Perfetto-loadable trace for the serving run."""
+    cfg, model, params = model_and_params
+    engine = ds.init_inference(
+        model,
+        dtype="fp32",
+        paged_kv={"page_size": 8, "max_slots": 4, "prefill_chunk": 8,
+                  "attn_impl": "xla"},
+    )
+    engine.set_params(params)
+    engine._ds_config = cfg  # converted-family contract
+    engine.serve(_prompts(3, seed=7), max_new_tokens=4)
+    rep = engine.observability()
+    assert set(rep) >= {"timeline", "metrics", "compile", "analysis", "serve"}
+    assert rep["timeline"]["phases"]["serve.step"]["count"] >= 1
+    assert rep["serve"]["finished"] == 3
+    assert any(n.startswith("paged_") for n in rep["compile"])
+    # the analysis merge is the real report (violations counted), not a stub
+    assert rep["analysis"]["totals"]["violations"] == 0
+    # Perfetto trace for a serving run
+    path = engine.observability_hub.export_chrome_trace(str(tmp_path / "serve.json"))
+    obj = json.load(open(path))
+    phs = {e["ph"] for e in obj["traceEvents"]}
+    assert {"X", "b", "e"} <= phs  # phase spans + request lifecycles
+    names = {e["name"] for e in obj["traceEvents"]}
+    assert "serve.dispatch" in names
+
+
+def test_chaos_kill_mid_emit_leaks_no_open_spans(model_and_params, tmp_path):
+    """A ChaosKilled fired from inside the emit path (the journal.append
+    hook runs between serve.emit's enter and exit) must unwind through the
+    span context managers without leaving phantom open spans — the
+    flight-recorder's open_spans answer stays truthful for the rest of the
+    process after an in-process recovery."""
+    from deepspeed_tpu.inference.journal import RequestJournal
+    from deepspeed_tpu.utils import chaos
+
+    cfg, _, params = model_and_params
+    tr = Tracer()
+    server = _server(
+        cfg, params, tracer=tr, journal=RequestJournal(str(tmp_path / "j"))
+    )
+    server.submit(np.arange(1, 9, dtype=np.int32), max_new_tokens=6)
+    try:
+        chaos.install(chaos.ChaosSchedule([chaos.ChaosRule("journal.append", hit=2)]))
+        with pytest.raises(chaos.ChaosKilled):
+            server.run()
+    finally:
+        chaos.uninstall()
+    assert tr.open_spans() == []
+
+
+def test_multi_tenant_server_exposes_tracer(model_and_params):
+    from deepspeed_tpu.inference.traffic import MultiTenantServer
+
+    cfg, _, params = model_and_params
+    tr = Tracer()
+    inner = _server(cfg, params, tracer=tr)
+    mt = MultiTenantServer(inner, tenants=[{"name": "a", "weight": 1.0}])
+    assert mt.tracer is tr
+    mt.serve(_prompts(1, seed=9), max_new_tokens=2, tenant="a")
+    assert tr.phase_summary()["serve.step"]["count"] >= 1

@@ -1,18 +1,34 @@
 """Monitor backend tests (reference: tests/unit/monitor/test_monitor.py).
 
-csvMonitor writes per-metric files; MonitorMaster fans out; the engine emits
-lr/train_loss events at steps_per_print boundaries.
+csvMonitor writes per-metric files; the torch-free JSONL backend is
+default-on behind the ``monitor`` block's master switch; MonitorMaster fans
+out; the engine emits lr/train_loss events — plus the observability hub's
+periodic metric feed — at the configured cadence.
 """
 
 import csv
+import json
 import os
 
 import numpy as np
 import pytest
 
 import deepspeed_tpu as ds
-from deepspeed_tpu.monitor.monitor import MonitorMaster, TensorBoardMonitor, WandbMonitor, csvMonitor
-from deepspeed_tpu.runtime.config import CSVConfig, MonitorConfig, TensorBoardConfig, WandbConfig
+from deepspeed_tpu.monitor.monitor import (
+    JSONLMonitor,
+    MonitorMaster,
+    TensorBoardMonitor,
+    WandbMonitor,
+    csvMonitor,
+)
+from deepspeed_tpu.runtime.config import (
+    CSVConfig,
+    DeepSpeedConfig,
+    JSONLConfig,
+    MonitorConfig,
+    TensorBoardConfig,
+    WandbConfig,
+)
 from tests.unit.simple_model import SimpleModel, random_dataloader
 
 
@@ -75,6 +91,105 @@ def test_wandb_monitor_degrades_without_package():
     except ImportError:
         assert not mon.enabled
         mon.write_events([("a", 1.0, 0)])
+
+
+def _read_jsonl(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def test_jsonl_monitor_writes_parseable_lines(tmp_path):
+    mon = JSONLMonitor(
+        JSONLConfig(enabled=True, output_path=str(tmp_path), job_name="job"),
+        master_enabled=True,
+    )
+    assert mon.enabled
+    mon.write_events([("Train/loss", 1.5, 0), ("Train/lr", 0.1, 4)])
+    mon.write_events([("Train/loss", 1.2, 8)])
+    recs = _read_jsonl(tmp_path / "job" / "events.jsonl")
+    assert [r["name"] for r in recs] == ["Train/loss", "Train/lr", "Train/loss"]
+    assert recs[0] == {"name": "Train/loss", "value": 1.5, "step": 0, "t": recs[0]["t"]}
+    assert all("t" in r for r in recs)
+
+
+def test_jsonl_gated_on_master_switch(tmp_path):
+    """jsonl.enabled defaults True but the backend only activates with the
+    monitor block's master switch (or force=True) — legacy configs that
+    never mention `monitor` keep writing nothing new."""
+    cfg = JSONLConfig(enabled=True, output_path=str(tmp_path), job_name="j")
+    assert not JSONLMonitor(cfg, master_enabled=False).enabled
+    assert JSONLMonitor(cfg, master_enabled=False, force=True).enabled
+
+
+def test_monitor_block_parses_and_defaults_jsonl_on(tmp_path):
+    cfg = DeepSpeedConfig(
+        {
+            "train_micro_batch_size_per_gpu": 1,
+            "monitor": {"enabled": True, "interval_steps": 3,
+                        "jsonl": {"output_path": str(tmp_path)}},
+        }
+    )
+    mc = cfg.monitor_config
+    assert mc.enabled and mc.active and mc.interval_steps == 3
+    assert mc.jsonl.enabled  # default-on behind the master switch
+    master = MonitorMaster(mc)
+    assert master.enabled and master.jsonl_monitor.enabled
+    assert not master.csv_monitor.enabled
+    # legacy top-level keys still reach the same config object
+    legacy = DeepSpeedConfig(
+        {
+            "train_micro_batch_size_per_gpu": 1,
+            "csv_monitor": {"enabled": True, "output_path": str(tmp_path)},
+        }
+    )
+    assert legacy.monitor_config.active and not legacy.monitor_config.enabled
+
+
+def test_monitor_block_rejects_typoed_keys(tmp_path):
+    """The block is validated whole by pydantic: a typo'd key fails loudly
+    instead of silently doing nothing."""
+    with pytest.raises(Exception):
+        DeepSpeedConfig(
+            {"train_micro_batch_size_per_gpu": 1, "monitor": {"enable": True}}
+        )
+    # the `csv` alias inside the block is sanctioned
+    cfg = DeepSpeedConfig(
+        {
+            "train_micro_batch_size_per_gpu": 1,
+            "monitor": {"enabled": True,
+                        "csv": {"enabled": True, "output_path": str(tmp_path)}},
+        }
+    )
+    assert cfg.monitor_config.csv_monitor.enabled
+
+
+def test_engine_monitor_block_jsonl_with_hub_feed(tmp_path, eight_devices):
+    """The satellite acceptance: the `monitor` block alone (no legacy keys)
+    wires the engine → MonitorMaster → JSONL, and the events include the
+    observability hub's periodic metric feed (trace phase means + metric
+    counters), every interval_steps optimizer steps."""
+    engine, *_ = ds.initialize(
+        model=SimpleModel(),
+        config={
+            "train_micro_batch_size_per_gpu": 8,
+            "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+            "bf16": {"enabled": True},
+            "monitor": {"enabled": True, "interval_steps": 1,
+                        "jsonl": {"output_path": str(tmp_path), "job_name": "run"}},
+        },
+    )
+    assert engine.monitor is not None and engine.monitor.jsonl_monitor.enabled
+    for batch in random_dataloader(total_samples=16, batch_size=8):
+        loss = engine(batch)
+        engine.backward(loss)
+        engine.step()
+    recs = _read_jsonl(tmp_path / "run" / "events.jsonl")
+    names = {r["name"] for r in recs}
+    assert "Train/Samples/train_loss" in names
+    assert "Metrics/train.steps" in names  # the hub's metric feed
+    assert any(n.startswith("Trace/train.dispatch") for n in names)
+    steps_feed = [r["value"] for r in recs if r["name"] == "Metrics/train.steps"]
+    assert steps_feed == [1.0, 2.0]  # interval_steps=1 → once per step
 
 
 def test_engine_writes_monitor_events(tmp_path, eight_devices):

@@ -44,6 +44,15 @@
 # The FULL subprocess kill -9 matrix is `pytest -m slow
 # tests/unit/checkpoint/test_chaos_matrix.py` (excluded here and from
 # tier-1).
+# +observability 2026-08-04 (test_tracer.py + test_flight_recorder.py +
+# test_telemetry_free.py + test_request_spans.py + monitor suite): unified
+# tracing plane — span nesting/ring/percentiles/thread-safety-with-async-
+# writer, serving request-lifecycle spans across admission/preemption/
+# spec-decode, chaos-kill flight-recorder postmortems (subprocess exit
+# case is `-m slow`), telemetry-is-free guard (0 new programs, host-
+# transfer pass clean, <2% overhead bound), engine.observability() merged
+# reports + Perfetto export, monitor block + JSONL backend + hub feed,
+# DS-R009 lint.
 cd "$(dirname "$0")/.." || exit 1
 sh tools/lint.sh || exit 1
 exec python -m pytest -q \
@@ -59,6 +68,11 @@ exec python -m pytest -q \
   tests/unit/checkpoint/test_fault_tolerance.py \
   tests/unit/inference/test_journal_recovery.py \
   tests/unit/utils/test_chaos.py \
+  tests/unit/profiling/test_tracer.py \
+  tests/unit/profiling/test_flight_recorder.py \
+  tests/unit/profiling/test_telemetry_free.py \
+  tests/unit/inference/test_request_spans.py \
+  tests/unit/monitor/test_monitor.py \
   tests/unit/inference/test_kv_pool.py \
   tests/unit/inference/test_serving.py \
   tests/unit/inference/test_ragged_serving.py \
