@@ -62,6 +62,37 @@ class CheckpointConfig(DeepSpeedConfigModel):
     base_dir: Optional[str] = None
 
 
+class MultiStepConfig(DeepSpeedConfigModel):
+    """Multi-step in-program serving windows (``decode.py:
+    build_ragged_multistep`` / ``scheduler.py:_ragged_window``).
+
+    With ``enable``, a scheduler step whose running set is stable — no
+    pending admissions, no prefill chunks, no drafts, no preemption
+    pressure — dispatches ONE fused ``lax.scan`` program of up to
+    ``horizon`` plain-decode rounds: per-row EOS/length stopping masks
+    freeze finished rows in-program, the page table rides in pre-reserved
+    for the whole window's KV growth, and the host dispatch gap, packing,
+    emit, and journal sync are paid once per window — steady-state
+    dispatches/token → ``1/horizon``. Any scheduling event falls back to
+    the single-step ragged path (``serve_stats()['window_break_reasons']``
+    counts why), so greedy streams stay byte-identical to single-step —
+    and to bucketed and dense — serving. One horizon is armed at a time,
+    adding at most ONE compiled serving program (≤ 4 total with the
+    narrow + mixed ragged widths)."""
+
+    enable: bool = False
+    horizon: int = 8  # decode rounds fused into one dispatch (>= 2)
+
+    @model_validator(mode="after")
+    def _check_horizon(self):
+        if self.enable and self.horizon < 2:
+            raise ValueError(
+                f"paged_kv.multi_step.horizon must be >= 2 (1 is the "
+                f"single-step path), got {self.horizon}"
+            )
+        return self
+
+
 class PagedKVConfig(DeepSpeedConfigModel):
     """Paged-KV serving knobs (``engine.serve()``: block-pool cache +
     continuous batching, ``inference/kv_pool.py`` / ``inference/scheduler.py``).
@@ -88,6 +119,11 @@ class PagedKVConfig(DeepSpeedConfigModel):
     verify programs when ``spec_decode.enable`` is set. Greedy streams
     are byte-identical across the two paths.
 
+    ``multi_step`` (see :class:`MultiStepConfig`) arms fused windows of N
+    plain-decode rounds per dispatch on top of the ragged path — the host
+    dispatch gap amortizes to 1/N in steady state, streams stay
+    byte-identical, and any scheduling event falls back to single-step.
+
     ``prefix_cache`` turns on page-level prefix sharing: full KV pages are
     indexed by a content chain hash, requests attach the longest cached
     prefix of their context by reference (refcounted, copy-on-write on
@@ -106,6 +142,18 @@ class PagedKVConfig(DeepSpeedConfigModel):
     attn_impl: str = "auto"  # auto | pallas | xla (decode attention backend)
     prefix_cache: bool = True  # page-level prefix sharing (hash-of-block + CoW)
     ragged: bool = True  # one ragged program per step (False = bucketed oracle)
+    # multi-step windows: N decode rounds fused into one dispatch when the
+    # running set is stable (requires the ragged path)
+    multi_step: MultiStepConfig = Field(default_factory=MultiStepConfig)
+
+    @model_validator(mode="after")
+    def _check_multi_step(self):
+        if self.multi_step.enable and not self.ragged:
+            raise ValueError(
+                "paged_kv.multi_step runs over the ragged serving path: "
+                "enable paged_kv.ragged (or disable multi_step)"
+            )
+        return self
 
 
 class TenantConfig(DeepSpeedConfigModel):

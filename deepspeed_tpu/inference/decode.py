@@ -565,7 +565,10 @@ def beam_generate(
 # handles mixed prefill-chunk, decode, and verify rows together, driven by
 # per-row (kv_len, q_len) metadata arrays — total compiled serving programs
 # ≤ 2 (a narrow decode/verify width plus the mixed width covering prefill
-# chunks). Bucketed mode (the token-exactness oracle): per decode step ONE
+# chunks). Multi-step windows (`build_ragged_multistep`, armed via
+# `paged_kv.multi_step`) add at most ONE more program per horizon: a
+# lax.scan of N plain-decode rounds dispatched when the running set is
+# stable, amortizing the host gap to 1/N. Bucketed mode (the token-exactness oracle): per decode step ONE
 # dispatch of a slot-bucket-sized program (or, with speculation, ONE
 # dispatch of a (bucket, K)-shaped verify program); per prompt chunk one
 # dispatch of a fixed-chunk prefill program — programs bounded by (slot
@@ -816,6 +819,97 @@ def build_paged_verify_step(cfg, bucket: int, K: int, page_size: int,
         return packed, new_k, new_v
 
     fn = _jit(_verify, telemetry, name, donate_argnums=(2, 3))
+    _paged_program_cache[key] = fn
+    return fn
+
+
+def build_ragged_multistep(cfg, rows: int, width: int, horizon: int, page_size: int,
+                           attn_impl: str = "auto", telemetry=None):
+    """N plain-decode rounds in ONE dispatch: a ``lax.scan`` of ``horizon``
+    iterations of the ragged step body, so the host dispatch gap, packing,
+    and journal syncs are paid once per WINDOW instead of once per token.
+
+    ``multistep(params, tokens [R], k_pages, v_pages, page_table [R, MAXP],
+    lengths [R], live [R], eos_ids [R], budgets [R])
+    -> (out [R, 1+N], k_pages, v_pages)``.
+
+    Row r starts from its pending token ``tokens[r]`` at live kv length
+    ``lengths[r]`` (``live[r] == 0`` marks dead padding rows). Each round
+    writes the carried token at the row's next position, attends through
+    the SAME ragged paged-attention entry the single-step program uses
+    (per-row ``(kv_len, q_len)`` metadata with ``q_len ∈ {0, 1}``), takes
+    the greedy argmax in-program, and advances the carry. Stopping is pure
+    in-program data: a row FREEZES — its ``q_len`` drops to 0, so further
+    writes redirect to the trash page and its length stops — the round it
+    emits its ``eos_ids[r]`` token (−1 = no EOS) or its ``budgets[r]``-th
+    window token. A frozen row is indistinguishable from a dead padding
+    row to every other row, which is what makes the window byte-identical
+    to ``horizon`` sequential single-step dispatches.
+
+    In-window KV growth needs no host resync: positions index the page
+    table (``position // page_size``), and the scheduler pre-reserves the
+    ``ceil(N / page_size) + 1`` pages a row can touch before dispatching
+    (``_reserve_for_growth``), so the table rides in already covering the
+    whole window.
+
+    ``out[:, 0]`` is the per-row emitted count n (≤ N); ``out[:, 1 : 1+n]``
+    the emitted tokens — everything packed into ONE array so the window's
+    single host fetch stays a single transfer. Pages are donated; the
+    table rides in per window (rebuilt host-side, nothing to alias back).
+
+    Compiled once per (rows, horizon): the scheduler arms one horizon, so
+    the serving program set stays ≤ narrow + mixed + one window program.
+    ``width`` is reserved for drafted windows and must be 1 today (plain
+    decode — the window mode only engages when drafting is idle).
+    """
+    if cfg.position == "alibi":
+        raise NotImplementedError("paged serving does not support alibi attention biases")
+    if width != 1:
+        raise ValueError(f"multi-step windows run plain decode only (width 1), got {width}")
+    if rows < 1 or horizon < 2:
+        raise ValueError(
+            f"multi-step window needs rows >= 1 and horizon >= 2, got "
+            f"{rows} rows x horizon {horizon}"
+        )
+    name = f"{_program_name('multistep', rows, width)}_n{int(horizon)}"
+    key = _paged_program_key(name, cfg, page_size, attn_impl, telemetry)
+    fn = _paged_program_cache.get(key)
+    if fn is not None:
+        return fn
+    N = int(horizon)
+
+    def _window(params, tokens, k_pages, v_pages, page_table, lengths, live,
+                eos_ids, budgets):
+        def round_fn(carry, _):
+            tok, kp, vp, lens, alive, emitted = carry
+            q_lens = alive.astype(jnp.int32)  # [R]: 1 live, 0 frozen/dead
+            kv_lens = jnp.where(alive, lens + 1, 0)
+            logits, kp, vp = _paged_forward(
+                cfg, params, tok[:, None], kp, vp, page_table, lens[:, None],
+                None, attn_impl, write_valid=alive[:, None],
+                prefill_kv_lens=kv_lens, ragged_q_lens=q_lens,
+            )
+            nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+            out_tok = jnp.where(alive, nxt, -1)
+            emitted = emitted + q_lens
+            lens = lens + q_lens
+            # freeze AFTER emitting the EOS / budget-hitting token — the
+            # scheduler's _emit includes that token, matching sequential
+            # decode's output contract
+            alive = alive & (nxt != eos_ids) & (emitted < budgets)
+            tok = jnp.where(alive, nxt, tok)
+            return (tok, kp, vp, lens, alive, emitted), out_tok
+
+        alive0 = live > 0
+        emitted0 = jnp.zeros_like(lengths)
+        (tok, kp, vp, lens, alive, emitted), toks = jax.lax.scan(
+            round_fn, (tokens, k_pages, v_pages, lengths, alive0, emitted0),
+            None, length=N,
+        )
+        packed = jnp.concatenate([emitted[:, None], toks.T], axis=1)  # [R, 1+N]
+        return packed, kp, vp
+
+    fn = _jit(_window, telemetry, name, donate_argnums=(2, 3))
     _paged_program_cache[key] = fn
     return fn
 

@@ -590,12 +590,23 @@ class InferenceEngine:
             spec_decode=self._config.spec_decode,
             prefix_cache=pcfg.prefix_cache,
             ragged=pcfg.ragged,
+            multi_step=pcfg.multi_step,
             journal=journal,
             tracer=self.tracer,
             metrics=self.metrics,
         )
         if recovered_states:
             server.recover(recovered_states, next_uid)
+        if self._obs_hub.flight_recorder is not None:
+            # postmortems must name the window config: a crash dump that
+            # shows a serve.window span is only readable next to the armed
+            # horizon (flight-recorder payloads carry this context block).
+            # Written unconditionally so a server REBUILT with windows
+            # disabled overwrites a stale armed-horizon claim
+            self._obs_hub.flight_recorder.context["serve.multi_step"] = {
+                "enable": bool(pcfg.multi_step.enable),
+                "horizon": int(pcfg.multi_step.horizon),
+            }
         tcfg = self._config.traffic
         if tcfg.enabled:
             # multi-tenant SLA layer (inference/traffic.py): weighted-deficit
@@ -614,7 +625,10 @@ class InferenceEngine:
         riding the SAME dispatch as in-flight decoders, and each step is
         ONE dispatch of the unified ragged program
         (``inference/scheduler.py``; ``paged_kv.ragged=False`` falls back
-        to the bucketed per-shape programs, byte-identical streams). With
+        to the bucketed per-shape programs, byte-identical streams) — or,
+        with ``paged_kv.multi_step`` armed and the running set stable, ONE
+        fused window of up to ``horizon`` decode rounds (host dispatch gap
+        amortized to 1/N, still byte-identical). With
         ``inference.spec_decode.enable`` host-side n-gram drafts verify
         inside the same per-step dispatch (per-request spec-K), token-exact
         under greedy. Accepts a list of 1-D
@@ -631,7 +645,9 @@ class InferenceEngine:
     def serve_stats(self):
         """Observability of the live paged server: scheduler counters
         (admitted, preempted, finished, prefill_chunks, decode_steps,
-        spec_rounds), speculation quality (``spec_accept_rate``,
+        spec_rounds), the multi-step window block (``window_steps``,
+        ``window_horizon``, ``dispatches_per_token``,
+        ``window_break_reasons``), speculation quality (``spec_accept_rate``,
         ``spec_mean_accepted_per_round``, the ``spec_accept_hist`` draft-hit
         histogram), pool occupancy/utilization, prefix-cache counters
         (``prefix`` — hit rate, CoW copies, cached pages), latency SLOs

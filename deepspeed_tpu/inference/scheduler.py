@@ -36,6 +36,17 @@ vLLM style):
   spec-K varies per request, and shifting the mix never retraces. Total
   compiled serving programs is ≤ 2 (the narrow decode/verify width plus
   the chunk-covering mixed width), vs the bucketed matrix's dozens;
+* with **multi-step windows** armed (``inference.paged_kv.multi_step``)
+  a step whose running set is STABLE — nothing queued, nothing
+  prefilling, no drafts, no preemption pressure — dispatches ONE fused
+  program of up to ``horizon`` plain-decode rounds
+  (``decode.py:build_ragged_multistep``): per-row EOS/budget stopping
+  masks freeze finished rows in-program (trash-page writes), the page
+  table rides in pre-reserved for the whole window's growth, and the
+  host pays its dispatch gap, packing, emit, and journal sync once per
+  window instead of once per token (dispatches/token → 1/horizon). Any
+  scheduling event breaks back to the single-step path — streams stay
+  byte-identical, and ``window_break_reasons`` names every break;
 * in **bucketed** mode (``ragged=False`` — kept as the token-exactness
   oracle) compiled-program count is bounded by the **slot-count buckets**
   (× the **spec lengths** when speculating): each round dispatches ONE
@@ -69,6 +80,7 @@ from deepspeed_tpu.inference.decode import (
     build_paged_decode_step,
     build_paged_prefill,
     build_paged_verify_step,
+    build_ragged_multistep,
     build_ragged_step,
 )
 from deepspeed_tpu.inference.journal import JournaledRequest, RequestJournal
@@ -91,9 +103,10 @@ def _spec_knob(spec, name, default):
 def compiled_serving_programs(compile_stats: Dict) -> int:
     """Count the serving programs a telemetry snapshot saw compile: every
     ``paged_*`` entry (the unified ``paged_<kind>_r<rows>_w<width>`` naming
-    across the decode/prefill/verify/ragged builders) with at least one
-    cold dispatch. The ragged compile-budget gate asserts this ≤ 2 for a
-    full mixed serve; ``bench.py`` records it as ``compiled_programs``."""
+    across the decode/prefill/verify/ragged/multistep builders) with at
+    least one cold dispatch. The ragged compile-budget gate asserts this
+    ≤ 2 for a full mixed serve — ≤ 4 with a multi-step window horizon
+    armed; ``bench.py`` records it as ``compiled_programs``."""
     return sum(
         1
         for name, rec in compile_stats.items()
@@ -220,6 +233,7 @@ class PagedServer:
         policy: Optional[SchedulingPolicy] = None,
         clock=None,
         ragged: bool = True,
+        multi_step=None,
         journal: Optional[RequestJournal] = None,
         tracer=None,
         metrics: Optional[MetricsRegistry] = None,
@@ -243,6 +257,31 @@ class PagedServer:
         # ragged=False keeps the bucketed per-shape programs as the
         # token-exactness oracle.
         self.ragged = bool(ragged)
+        # multi-step windows (inference.paged_kv.multi_step): when the
+        # running set is STABLE — nothing queued, nothing prefilling, no
+        # drafts, no preemption pressure — a step dispatches ONE fused
+        # program of `horizon` plain-decode rounds (decode.py:
+        # build_ragged_multistep), paying the host dispatch gap, packing,
+        # and journal sync once per window instead of once per token. Any
+        # scheduling event falls back to the single-step ragged path, so
+        # prefix cache, CoW, SLA tenancy, spec decode, and the journal
+        # ride unchanged and streams stay byte-identical.
+        self.ms_enable = bool(_spec_knob(multi_step, "enable", False))
+        self.ms_horizon = int(_spec_knob(multi_step, "horizon", 8))
+        if self.ms_enable and not self.ragged:
+            raise ValueError(
+                "multi_step windows run over the ragged serving path: "
+                "enable paged_kv.ragged (or disable paged_kv.multi_step)"
+            )
+        if self.ms_enable and self.ms_horizon < 2:
+            raise ValueError(
+                f"multi_step.horizon must be >= 2 (1 is the single-step "
+                f"path), got {self.ms_horizon}"
+            )
+        # drafts handed from a failed window-eligibility probe to the
+        # single-step fallback, so a (possibly stateful) Drafter is asked
+        # at most once per scheduler step
+        self._predrafts: Optional[Dict[int, np.ndarray]] = None
         self.policy = policy or YoungestFirstPolicy()
         # crash-recovery journal (inference/journal.py): admissions and
         # emitted tokens are appended per event and made durable ONCE per
@@ -328,6 +367,23 @@ class PagedServer:
             # carried plain-decode / drafted rows (a mixed dispatch can
             # count as both)
             "ragged_steps": 0,
+            # multi-step windows: one fused horizon-round dispatch each;
+            # `dispatches` counts EVERY serving dispatch (windows, ragged
+            # steps, bucketed prefill/decode/verify) and `emitted_tokens`
+            # every generated token, so dispatches_per_token is derivable
+            "window_steps": 0,
+            "dispatches": 0,
+            "emitted_tokens": 0,
+            # why a window could not form (admission pending, a row mid
+            # prefill, drafts proposed, page-pool reservation pressure) or
+            # ended before its horizon (EOS / token budget) — the
+            # steady-state postmortem counters. "pool" and "budget" need
+            # OPPOSITE remediations (grow the pool vs lower the horizon),
+            # so they are never folded together
+            "window_break_reasons": {
+                "admission": 0, "prefill": 0, "draft": 0, "eos": 0,
+                "budget": 0, "pool": 0,
+            },
             "decode_steps": 0,  # plain (non-speculative) decode dispatches
             "spec_rounds": 0,  # verify dispatches (one per speculative round)
             "spec_drafted": 0,  # draft tokens sent to verification
@@ -477,14 +533,17 @@ class PagedServer:
     def step(self) -> None:
         """Admit what fits, then run the round's device work: in ragged
         mode ONE dispatch covering every active row's next tokens (prefill
-        chunks, pending decodes, and drafted verifies together); in
-        bucketed mode one prefill dispatch per chunk followed by one
-        decode/verify dispatch over the running set."""
+        chunks, pending decodes, and drafted verifies together) — or, with
+        ``multi_step`` armed and the running set stable, ONE fused window
+        of ``horizon`` plain-decode rounds; in bucketed mode one prefill
+        dispatch per chunk followed by one decode/verify dispatch over the
+        running set."""
         with self.tracer.span("serve.step"):
             with self.tracer.span("serve.admit"):
                 self._admit()
             if self.ragged:
-                self._ragged_step()
+                if not (self.ms_enable and self._ragged_window()):
+                    self._ragged_step(drafts=self._take_predrafts())
             else:
                 with self.tracer.span("serve.prefill"):
                     self._prefill_step()
@@ -608,6 +667,7 @@ class PagedServer:
                 pt, np.asarray([start], np.int32), np.int32(real - 1),
             )
             self.pool.set_cache(new_k, new_v)
+            self.stats["dispatches"] += 1
             self.pool.advance(req.slot, real)
             req.consumed = start + real
             if self.prefix_cache:
@@ -631,7 +691,13 @@ class PagedServer:
         self._plain_decode_step(running)
 
     # --- the ragged one-program step -------------------------------------
-    def _ragged_step(self) -> None:
+    def _take_predrafts(self) -> Optional[Dict[int, np.ndarray]]:
+        """Drafts a failed window probe already proposed this step (the
+        Drafter is asked at most once per step — it may be stateful)."""
+        drafts, self._predrafts = self._predrafts, None
+        return drafts
+
+    def _ragged_step(self, drafts: Optional[Dict[int, np.ndarray]] = None) -> None:
         """ONE dispatch for the whole round: every active row contributes
         its next tokens — a prefill chunk, the pending decode token, or the
         pending token plus host-side drafts — packed into a single
@@ -644,9 +710,12 @@ class PagedServer:
         if not rows:
             return
         with self.tracer.span("serve.pack") as pack_span:
-            drafts: Dict[int, np.ndarray] = {}
-            if self.drafter is not None:
-                drafts = self._propose_drafts([r for r in rows if r.pending is not None])
+            if drafts is None:
+                drafts = {}
+                if self.drafter is not None:
+                    drafts = self._propose_drafts(
+                        [r for r in rows if r.pending is not None]
+                    )
             chunk_len: Dict[int, int] = {}
             need: Dict[int, int] = {}
             for r in rows:
@@ -695,6 +764,7 @@ class PagedServer:
             )
             self.pool.set_cache(new_k, new_v)
         self.stats["ragged_steps"] += 1
+        self.stats["dispatches"] += 1
         with self.tracer.span("serve.emit"):
             self._settle_ragged_rows(rows, out, chunk_len, q_lens)
 
@@ -733,14 +803,153 @@ class PagedServer:
         if had_spec:
             self.stats["spec_rounds"] += 1
 
-    def _reserve_for_growth(self, running: List[Request], need: Dict[int, int]) -> List[Request]:
+    # --- the multi-step window (one dispatch = N decode rounds) ----------
+    def _window_break(self, reason: str) -> None:
+        self.stats["window_break_reasons"][reason] += 1
+
+    def _ragged_window(self) -> bool:
+        """Try to serve this step as ONE fused window of ``ms_horizon``
+        plain-decode rounds (``decode.py:build_ragged_multistep``). The
+        window forms only when the running set is STABLE — no pending
+        admissions, no row mid-prefill, no drafts proposed, every row's
+        remaining budget worth amortizing, and the whole window's pages
+        reservable WITHOUT preemption; any scheduling event records its
+        break reason and returns False, and the caller falls back to the
+        single-step ragged path (byte-identical streams either way — the
+        window program freezes rows in-program exactly where sequential
+        steps would retire them). Per-row EOS ids and token budgets ride
+        in as arrays, so the fused program never overruns a stream."""
+        rows = [r for r in self._active if not r.done]
+        if not rows:
+            return False
+        if self._queue:
+            # an admission is waiting: a window would starve its TTFT for
+            # up to N rounds — serve single-step until the queue drains
+            self._window_break("admission")
+            return False
+        if any(r.pending is None for r in rows):
+            self._window_break("prefill")
+            return False
+        H = self.ms_horizon
+        if max(r.max_new_tokens - len(r.generated) for r in rows) < H:
+            # every row would freeze before the horizon: the single-step
+            # tail is strictly cheaper than a mostly-frozen window
+            self._window_break("budget")
+            return False
+        if self.drafter is not None:
+            # stash the proposals whichever way the probe resolves: the
+            # single-step fallback consumes them instead of re-asking a
+            # (possibly stateful) Drafter twice in one step
+            self._predrafts = drafts = self._propose_drafts(rows)
+            if any(d.size for d in drafts.values()):
+                # speculation outruns a plain-decode window
+                self._window_break("draft")
+                return False
+        # pre-reserve the whole window's growth — ceil(N/page_size)+1
+        # pages per row worst case — WITHOUT preempting: pool pressure is
+        # a scheduling event, and the single-step path owns preemption.
+        # Per row the reservation is min(H, remaining budget): the
+        # in-program budget freeze bounds the row's writes to its budget,
+        # so a near-finished row never demands pages (or max_seq_len
+        # room) it cannot write — submit() guarantees len + budget fits
+        need = {
+            r.uid: min(H, r.max_new_tokens - len(r.generated)) for r in rows
+        }
+        if self._reserve_for_growth(rows, need, preempt=False) is None:
+            self._window_break("pool")
+            return False
+        # the window dispatches: drop the (all-empty) stash — a later
+        # step's fallback must ask the drafter fresh, not read this one
+        self._predrafts = None
+        with self.tracer.span("serve.window", rows=len(rows), horizon=H):
+            with self.tracer.span("serve.pack") as pack_span:
+                R, page_table, lengths = self._dispatch_rows(
+                    rows, pad_to=self.pool.max_slots
+                )
+                tokens = np.zeros(R, np.int32)
+                live = np.zeros(R, np.int32)
+                eos_ids = np.full(R, -1, np.int32)
+                budgets = np.zeros(R, np.int32)
+                for i, r in enumerate(rows):
+                    tokens[i] = r.pending
+                    live[i] = 1
+                    if r.eos_token_id is not None:
+                        eos_ids[i] = r.eos_token_id
+                    budgets[i] = r.max_new_tokens - len(r.generated)  # >= 1
+                pack_span.set(rows=len(rows), horizon=H)
+            with self.tracer.span("serve.dispatch", rows=len(rows), width=1,
+                                  horizon=H):
+                window_fn = build_ragged_multistep(
+                    self.cfg, R, 1, H, self.pool.page_size,
+                    attn_impl=self.attn_impl, telemetry=self.telemetry,
+                )
+                out, new_k, new_v = window_fn(
+                    self.params, tokens, self.pool.cache.k_pages,
+                    self.pool.cache.v_pages, page_table, lengths, live,
+                    eos_ids, budgets,
+                )
+                self.pool.set_cache(new_k, new_v)
+            self.stats["window_steps"] += 1
+            self.stats["dispatches"] += 1
+            with self.tracer.span("serve.emit"):
+                self._settle_window_rows(rows, out, H)
+            # crash INSIDE the window's host phase: every emitted token of
+            # the window sits in the journal buffer, none acked — recovery
+            # replays from the last synced token and the greedy re-prefill
+            # re-derives the window's tokens byte-identically
+            chaos.point("serve.mid_window")
+        return True
+
+    def _settle_window_rows(self, rows, out, horizon: int) -> None:
+        """Post-dispatch accounting for one window: the single budgeted
+        host fetch (``[R, 1+N]`` = per-row emitted count + tokens), then
+        per-row advance/emit/publish, amortized over up to N tokens per
+        row. Rows that froze before the horizon name the window's break
+        reason (EOS vs budget); surplus reserved pages go back to the
+        pool so a parked reservation never starves the next admission."""
+        out = np.asarray(out)  # lint: allow(DS-R005) — the window's one fetch
+        eos_broke = budget_broke = False
+        for i, r in enumerate(rows):
+            n = int(out[i, 0])
+            self.pool.advance(r.slot, n)
+            for tok in out[i, 1 : 1 + n]:
+                self._emit(r, int(tok))
+            if r.done and n < horizon:
+                if (
+                    r.eos_token_id is not None
+                    and r.generated
+                    and r.generated[-1] == r.eos_token_id
+                ):
+                    eos_broke = True
+                else:
+                    budget_broke = True
+            if not r.done:
+                if self.prefix_cache:
+                    self.pool.register_prefix(
+                        r.slot, r.context(), int(self.pool.seq_lens[r.slot])
+                    )
+                self.pool.trim_reservation(r.slot)
+        if eos_broke:
+            self._window_break("eos")
+        if budget_broke:
+            self._window_break("budget")
+
+    def _reserve_for_growth(self, running: List[Request], need: Dict[int, int],
+                            preempt: bool = True) -> Optional[List[Request]]:
         """Make every running row writable for its next ``need[uid]`` tokens
         (default 1) — page growth plus the pool's copy-on-write barrier for
         any shared prefix page in the written span — preempting the
         policy's victim (default: youngest active request) when the pool is
         dry; vLLM's recompute preemption: the victim's greedy continuation
         is re-derived exactly on re-admission. Mutates and returns
-        ``running`` (preempted rows leave the round)."""
+        ``running`` (preempted rows leave the round).
+
+        ``preempt=False`` is the multi-step window's reservation mode (a
+        whole horizon's pages per row, up front): preemption pressure is a
+        scheduling event that should BREAK the window, not evict anyone —
+        on the first row the pool cannot host, every reservation this call
+        already made is handed back (``trim_reservation``) and None is
+        returned so the caller falls back to the single-step path."""
         idx = 0
         while idx < len(running):
             req = running[idx]
@@ -748,6 +957,10 @@ class PagedServer:
             while not self.pool.prepare_write(
                 req.slot, int(self.pool.seq_lens[req.slot]) + grow
             ):
+                if not preempt:
+                    for r in running[: idx + 1]:
+                        self.pool.trim_reservation(r.slot)
+                    return None
                 candidates = [r for r in self._active if r is not req]
                 if not candidates:
                     # unreachable while submit() validates total size, kept
@@ -825,6 +1038,7 @@ class PagedServer:
         )
         self.pool.set_cache(new_k, new_v)
         self.stats["decode_steps"] += 1
+        self.stats["dispatches"] += 1
         # the step's single host fetch: [bucket] tokens
         out = np.asarray(out)  # lint: allow(DS-R005)
         for i, req in enumerate(running):
@@ -886,6 +1100,7 @@ class PagedServer:
         )
         self.pool.set_cache(new_k, new_v)
         self.stats["spec_rounds"] += 1
+        self.stats["dispatches"] += 1
         # the round's single host fetch: [bucket, K+2] = accept count + the
         # greedy token after each prefix
         out = np.asarray(out)  # lint: allow(DS-R005)
@@ -905,6 +1120,7 @@ class PagedServer:
             self.tracer.instant_async("request", req.uid, "first_token")
         req.generated.append(token)
         req.pending = token
+        self.stats["emitted_tokens"] += 1
         self.metrics.counter("serve.tokens").inc()
         if self.journal is not None:
             self.journal.append_emit(req.uid, token)
@@ -973,7 +1189,11 @@ class PagedServer:
 
     def serve_stats(self) -> Dict:
         """Scheduler counters (incl. ``ragged_steps`` — one per unified
-        dispatch on the default path) plus derived speculation observability
+        dispatch on the default path — and the multi-step window block:
+        ``window_steps`` fused dispatches, ``window_horizon``,
+        ``dispatches_per_token`` over every serving dispatch and emitted
+        token, and ``window_break_reasons`` naming why windows could not
+        form or ended early) plus derived speculation observability
         (acceptance rate, mean accepted drafts per round, draft-hit
         histogram), pool occupancy/utilization, prefix-cache counters
         (hit rate, CoW copies, cached pages), and latency SLOs — aggregate
@@ -983,10 +1203,18 @@ class PagedServer:
         records per serving config."""
         s = dict(self.stats)
         s["spec_accept_hist"] = list(self.stats["spec_accept_hist"])
+        s["window_break_reasons"] = dict(self.stats["window_break_reasons"])
         drafted, rounds = s["spec_drafted"], s["spec_rounds"]
         s["spec_accept_rate"] = s["spec_accepted"] / drafted if drafted else 0.0
         s["spec_mean_accepted_per_round"] = (
             s["spec_accepted"] / rounds if rounds else 0.0
+        )
+        # dispatch amortization (multi-step windows): every serving
+        # dispatch over every emitted token — steady-state windows drive
+        # this toward 1/horizon; 0.0 before anything has been emitted
+        s["window_horizon"] = self.ms_horizon if self.ms_enable else 0
+        s["dispatches_per_token"] = (
+            s["dispatches"] / s["emitted_tokens"] if s["emitted_tokens"] else 0.0
         )
         s.update(
             live_tokens=self.pool.live_tokens(),

@@ -69,6 +69,8 @@ POINTS = (
     "ckpt.mid_array_write",
     "ckpt.post_commit",
     "serve.mid_step",
+    "serve.mid_window",  # inside a multi-step window's host phase: the whole
+    # window's tokens are buffered in the journal, none yet acked
     "journal.append",
 )
 

@@ -335,3 +335,65 @@ class TestServeRecovery:
         srv2.run()
         for uid, want in zip(uids, ref):
             np.testing.assert_array_equal(srv2.take_result(uid), want)
+
+
+# ---------------------------------------------------------------------------
+# multi-step windows: one durability point per window, crash mid-window
+# ---------------------------------------------------------------------------
+MS = {"multi_step": {"enable": True, "horizon": 4}}
+
+
+class TestMultiStepWindowRecovery:
+    @pytest.mark.parametrize("hit", [1, 2])
+    def test_mid_window_crash_streams_resume_byte_identical(
+        self, tmp_path, eight_devices, hit
+    ):
+        """A crash INSIDE a window's host phase (every token of the window
+        buffered in the journal, none acked) replays byte-identically from
+        the last acked token — the window's whole emission is re-derived
+        by the greedy re-prefill, whether the restarted engine windows or
+        not."""
+        ref = _engine(**MS).serve(PROMPTS, max_new_tokens=16)
+
+        eng = _engine(tmp_path, **MS)
+        srv = eng._paged_server
+        uids = [srv.submit(p, max_new_tokens=16) for p in PROMPTS]
+        chaos.install(chaos.ChaosSchedule(
+            [chaos.ChaosRule("serve.mid_window", hit=hit)]
+        ))
+        with pytest.raises(chaos.ChaosKilled):
+            srv.run()
+        chaos.uninstall()
+        assert srv.stats["window_steps"] >= hit  # the armed point really fired
+
+        # restart once windowed, once single-step: the journal contract is
+        # identical — byte-identical resumption from the last acked token
+        over = MS if hit == 1 else {}
+        eng2 = _engine(tmp_path, **over)
+        srv2 = eng2._paged_server
+        assert srv2.stats["recovered"] == len(PROMPTS)
+        srv2.run()
+        for uid, want in zip(uids, ref):
+            np.testing.assert_array_equal(srv2.take_result(uid), want)
+        srv2.pool.integrity_check()
+
+    def test_window_journal_syncs_once_per_window(self, tmp_path, eight_devices):
+        """Durability is amortized with the dispatches: buffered tokens
+        land in ONE ``journal.sync`` per scheduler step, so a window's
+        worth of tokens costs a single durability point — far fewer syncs
+        than emitted tokens (the single-step path pays one per token)."""
+        eng = _engine(tmp_path, **MS)
+        srv = eng._paged_server
+        outs = eng.serve(PROMPTS, max_new_tokens=13)
+        ref = _engine(**MS).serve(PROMPTS, max_new_tokens=13)
+        for got, want in zip(outs, ref):
+            np.testing.assert_array_equal(got, want)
+        st = srv.serve_stats()
+        assert st["window_steps"] >= 1
+        syncs = [
+            s for s in eng.tracer.spans() if s["name"] == "serve.journal_sync"
+        ]
+        steps = [s for s in eng.tracer.spans() if s["name"] == "serve.step"]
+        assert len(syncs) == len(steps)  # one durability point per step
+        # the amortization: a window's tokens share one sync
+        assert len(syncs) < st["emitted_tokens"], (len(syncs), st["emitted_tokens"])

@@ -53,6 +53,14 @@
 # transfer pass clean, <2% overhead bound), engine.observability() merged
 # reports + Perfetto export, monitor block + JSONL backend + hub feed,
 # DS-R009 lint.
+# +multi-step windows 2026-08-04 (test_multistep_serving.py + extended
+# test_journal_recovery.py + analysis window gate): N-decode-rounds-per-
+# dispatch fused windows — window vs single-step vs bucketed vs dense
+# byte-identical across EOS-in-window/window-edge/admission-break/
+# preemption/prefix-attach/spec-handoff, steady-state dispatches/token
+# ≤ 1/horizon via telemetry, ≤4-compiled-programs + retrace guards,
+# mid-window crash recovery + one-journal-sync-per-window, window-program
+# green sweep (donation through the lax.scan carry, 0 host transfers).
 cd "$(dirname "$0")/.." || exit 1
 sh tools/lint.sh || exit 1
 exec python -m pytest -q \
@@ -76,6 +84,7 @@ exec python -m pytest -q \
   tests/unit/inference/test_kv_pool.py \
   tests/unit/inference/test_serving.py \
   tests/unit/inference/test_ragged_serving.py \
+  tests/unit/inference/test_multistep_serving.py \
   tests/unit/inference/test_spec_decode.py \
   tests/unit/inference/test_traffic.py \
   tests/unit/ops/test_paged_attention.py \
