@@ -51,6 +51,15 @@ Rules (each one traces back to a real incident in PERF.md / PR history):
   Route timing through the engine's tracer/timers (``profiling/tracer.py``,
   ``utils/timer.py`` — both files are out of scope for the rule, as is
   ``utils/sync.py``); deliberate exceptions carry a pragma.
+* **DS-R010 jax-import-in-host-only-module** — an ``import jax`` /
+  ``from jax ...`` (incl. ``jax.numpy``) anywhere in a module declared
+  pure-host: the fleet router (``inference/fleet.py``) and the tracer
+  (``profiling/tracer.py``). These components supervise/observe device
+  work from OUTSIDE the device path — the router must keep routing,
+  migrating, and journal-replaying while a replica's device backend is
+  wedged, and the tracer's zero-transfer/zero-program guarantee rests on
+  never touching jax. A jax dependency creeping in would silently couple
+  them to backend init (the 25-minute tunnel stall class of failure).
 * **DS-R007 pool-internals-mutated-outside-pool** — writing ``PagePool``
   internals (page tables, seq lens, free lists, refcounts, the prefix
   index, or the device cache) from outside the pool's own methods: the
@@ -85,8 +94,14 @@ RULES = {
     "DS-R007": "PagePool internals mutated outside the pool's own methods",
     "DS-R008": "non-atomic persistence write (open 'w' without temp+rename) in a checkpoint/journal/bench path",
     "DS-R009": "raw clock / device_sync call inside an engine/scheduler step-loop method (route through the tracer/timer)",
+    "DS-R010": "jax import in a host-only module (the fleet router / tracer must stay pure host code)",
 }
 _WARN_ONLY = {"DS-R003", "DS-R004"}
+
+# DS-R010 scope: modules that must never import jax — the fleet router
+# keeps serving decisions alive while device backends wedge, and the
+# tracer's telemetry-is-free contract forbids any device coupling.
+_R010_HOST_ONLY = re.compile(r"(inference/fleet\.py|profiling/tracer\.py)$")
 
 # DS-R008 scope: files (or enclosing functions) that persist state other
 # code will later trust — checkpoint layouts, journals, bench records.
@@ -571,6 +586,28 @@ def lint_source(src: str, path: str = "<string>") -> List[LintFinding]:
             _scan_r008(child, fn_in_scope)
 
     _scan_r008(tree, False)
+
+    # ---- DS-R010: jax imports in host-only modules --------------------
+    if _R010_HOST_ONLY.search(path.replace(os.sep, "/")):
+        for node in ast.walk(tree):
+            bad = None
+            if isinstance(node, ast.Import):
+                bad = next(
+                    (a.name for a in node.names
+                     if a.name == "jax" or a.name.startswith("jax.")),
+                    None,
+                )
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                if node.module == "jax" or node.module.startswith("jax."):
+                    bad = node.module
+            if bad:
+                add(
+                    node.lineno,
+                    "DS-R010",
+                    f"import of {bad!r} in host-only module {os.path.basename(path)}: "
+                    "the fleet router / tracer must keep working while the "
+                    "device backend is wedged — keep them pure host code",
+                )
 
     # ---- DS-R004: jit call sites without donation ---------------------
     for call in collector.jit_calls:

@@ -9,3 +9,7 @@ from deepspeed_tpu.elasticity.elasticity import (
 )
 from deepspeed_tpu.elasticity.config import ElasticityConfig, ElasticityConfigError, ElasticityError
 from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent, WorkerSpec
+from deepspeed_tpu.elasticity.fleet_policy import (
+    FleetResizePolicy,
+    valid_fleet_sizes,
+)

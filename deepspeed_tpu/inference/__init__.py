@@ -1,5 +1,10 @@
 """Inference: engine, KV-cached decode, and the paged serving layer."""
 
+from deepspeed_tpu.inference.fleet import (  # noqa: F401
+    ConsistentHashRing,
+    FleetRouter,
+    ReplicaHandle,
+)
 from deepspeed_tpu.inference.kv_pool import (  # noqa: F401
     PagedKVCache,
     PagePool,

@@ -26,10 +26,16 @@ Layout: numbered segments under the journal directory.
 
 Record types: ``s`` submit (uid, prompt, budget, eos, tenant, and — for
 recovery re-submits — the tokens already emitted), ``e`` emit (uid, token),
-``f`` finish (uid). A later ``s`` for the same uid replaces the earlier
-state, which is how recovery compacts: the restarted server journals one
-seeded submit per live request into a fresh segment, so the chain stays
-replayable from any point without rewriting history.
+``f`` finish (uid), ``m`` migrated-out (uid — the request now lives in
+ANOTHER replica's journal, so replaying this one must not resurrect it;
+the fleet router writes it after a live migration lands on the target).
+A later ``s`` for the same uid replaces the earlier state, which is how
+recovery compacts: the restarted server journals one seeded submit per
+live request into a fresh segment, so the chain stays replayable from any
+point without rewriting history. ``begin_compaction()`` is the same move
+for a LIVE server (fleet migration/drain): seal, re-seed the current
+state into a fresh segment, then ``retire_older_segments()`` — journal
+growth stays bounded however many requests migrate through.
 """
 
 from __future__ import annotations
@@ -67,6 +73,11 @@ class JournaledRequest:
     tenant: str
     generated: List[int] = field(default_factory=list)
     finished: bool = False
+    # server-clock timestamps, meaningful only within one clock domain (a
+    # live fleet's migrations); a fresh process ignores them — its clock
+    # restarted, so preserved stamps would corrupt TTFT
+    t_submit: Optional[float] = None
+    t_first: Optional[float] = None
 
     @property
     def done(self) -> bool:
@@ -133,6 +144,8 @@ class RequestJournal:
         eos_token_id: Optional[int],
         tenant: str,
         generated: Optional[List[int]] = None,
+        t_submit: Optional[float] = None,
+        t_first: Optional[float] = None,
     ) -> None:
         rec = {
             "t": "s",
@@ -144,13 +157,36 @@ class RequestJournal:
         }
         if generated:
             rec["gen"] = [int(t) for t in generated]
+        if t_submit is not None:
+            rec["ts"] = float(t_submit)
+        if t_first is not None:
+            rec["tf"] = float(t_first)
         self._buffer.append(_encode(rec))
 
     def append_emit(self, uid: int, token: int) -> None:
         self._buffer.append(_encode({"t": "e", "uid": int(uid), "tok": int(token)}))
 
+    def append_first_token(self, uid: int, t_first: float) -> None:
+        """One-time stamp of the request's first emission (one record per
+        request, not per token): replay preserves TTFT for requests
+        re-routed mid-stream — without it, a dead replica's mid-stream
+        requests would recompute TTFT from their post-kill re-emission,
+        overstating the very latency the fleet bench reports."""
+        self._buffer.append(
+            _encode({"t": "t", "uid": int(uid), "tf": float(t_first)})
+        )
+
     def append_finish(self, uid: int) -> None:
         self._buffer.append(_encode({"t": "f", "uid": int(uid)}))
+
+    def append_migrate(self, uid: int) -> None:
+        """The request migrated to another replica: replaying THIS journal
+        must no longer produce it (its authoritative state — including
+        every journaled emission — was re-seeded into the target replica's
+        journal before this record is written, so no crash window loses
+        it; a crash BETWEEN the two journals double-claims the uid and the
+        fleet router dedupes on adoption)."""
+        self._buffer.append(_encode({"t": "m", "uid": int(uid)}))
 
     def sync(self) -> None:
         """Flush buffered records to the active segment and make them
@@ -174,6 +210,22 @@ class RequestJournal:
         self.sync()
         if self._fh is not None:
             self._seal()
+
+    def begin_compaction(self) -> None:
+        """Seal the active segment and move the retirement boundary past
+        every segment written so far. The caller then re-journals the
+        server's FULL current state (seeded submits for live requests,
+        submit+finish for unclaimed results) and ``sync()``s — the fresh
+        segment alone replays to the same state — after which
+        ``retire_older_segments()`` drops all pre-compaction segments.
+        This is the live-server form of the restart-time compaction
+        ``PagedServer.recover`` performs, used after fleet migrations so
+        the source journal never accumulates records for requests that
+        now live elsewhere."""
+        self.sync()
+        if self._fh is not None:
+            self._seal()
+        self._first_seg_index = self._seg_index
 
     def retire_older_segments(self) -> int:
         """Delete every segment from BEFORE this writer's lifetime. Call
@@ -316,13 +368,22 @@ class RequestJournal:
                         eos_token_id=rec.get("eos"),
                         tenant=rec.get("tenant", "default"),
                         generated=[int(t) for t in rec.get("gen", [])],
+                        t_submit=rec.get("ts"),
+                        t_first=rec.get("tf"),
                     )
                 elif rec["t"] == "e":
                     if uid in states:
                         states[uid].generated.append(int(rec["tok"]))
+                elif rec["t"] == "t":
+                    if uid in states:
+                        states[uid].t_first = rec.get("tf")
                 elif rec["t"] == "f":
                     if uid in states:
                         states[uid].finished = True
+                elif rec["t"] == "m":
+                    # migrated out: the target replica's journal owns the
+                    # request now — replaying this one must not clone it
+                    states.pop(uid, None)
         return states, next_uid
 
     @staticmethod

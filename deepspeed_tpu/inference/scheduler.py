@@ -87,7 +87,11 @@ from deepspeed_tpu.inference.journal import JournaledRequest, RequestJournal
 from deepspeed_tpu.inference.kv_pool import PagePool
 from deepspeed_tpu.inference.spec_decode import Drafter, NGramDrafter
 from deepspeed_tpu.models.config import TransformerConfig
-from deepspeed_tpu.profiling.tracer import NULL_TRACER, MetricsRegistry
+from deepspeed_tpu.profiling.tracer import (
+    NULL_TRACER,
+    MetricsRegistry,
+    percentile_summary,
+)
 from deepspeed_tpu.utils import chaos
 
 
@@ -355,11 +359,21 @@ class PagedServer:
         # (tenant, ttft_ms, tpot_ms|None, n_tokens) per finished request —
         # the load harness derives SLA goodput from this
         self._finished_log: deque = deque(maxlen=65536)
+        # migrated-out records appended since the last full compaction —
+        # the journal's garbage counter (see finalize_migration)
+        self._migrated_since_compact = 0
+        # requests that migrated to a JOURNAL-LESS replica: THIS journal
+        # keeps their only durable claim (state as of the migration) until
+        # the fleet reports them finished — see retain_migrated_claim
+        self._foreign_claims: Dict[int, "JournaledRequest"] = {}
         self.stats = {
             "admitted": 0,
             "preempted": 0,
             "finished": 0,
             "recovered": 0,  # live requests rebuilt from the journal
+            "migrated_out": 0,  # live requests extracted for fleet migration
+            "migrated_in": 0,  # live requests adopted from another replica
+            "journal_compactions": 0,  # full-state rewrites (amortized)
             "prefix_cached_tokens": 0,  # context tokens attached, not prefilled
             "prefill_chunks": 0,
             # ragged mode: every scheduler step is ONE ragged dispatch;
@@ -441,10 +455,11 @@ class PagedServer:
             )
         uid = self._next_uid
         self._next_uid += 1
+        now = self.clock()
         self._queue.append(
             Request(uid=uid, prompt=prompt, max_new_tokens=int(max_new_tokens),
                     eos_token_id=eos_token_id, tenant=tenant,
-                    t_submit=self.clock())
+                    t_submit=now)
         )
         self._tenant(tenant)["submitted"] += 1
         # the request's lifecycle span opens at submit (queue wait included,
@@ -452,14 +467,20 @@ class PagedServer:
         self.tracer.begin_async("request", uid, f"req{uid}", tenant=tenant)
         if self.journal is not None:
             self.journal.append_submit(
-                uid, prompt, int(max_new_tokens), eos_token_id, tenant
+                uid, prompt, int(max_new_tokens), eos_token_id, tenant,
+                t_submit=now,
             )
             # admissions are durable at submit time, not at the next step:
             # a request accepted then crashed-on must survive the restart
             self.journal.sync()
         return uid
 
-    def recover(self, states: Dict[int, "JournaledRequest"], next_uid: int = 0) -> int:
+    def recover(
+        self,
+        states: Dict[int, "JournaledRequest"],
+        next_uid: int = 0,
+        migrated_in: bool = False,
+    ) -> int:
         """Rebuild the server from replayed journal state (a restart after
         a crash). Finished requests land directly in the results map (their
         output is fully journaled); every live request is re-queued with
@@ -473,7 +494,18 @@ class PagedServer:
         segment, which then alone replays to the same state, so the
         superseded pre-crash segments are retired (journal growth stays
         bounded across crash/recover cycles). Returns the number of live
-        requests recovered."""
+        requests recovered.
+
+        ``migrated_in=True`` is the LIVE-fleet form (this server is a
+        migration/re-route target in a running fleet): the requests'
+        original ``t_submit``/``t_first`` stamps are preserved — the fleet
+        shares one clock, and resetting them would erase pre-move queue
+        wait from TTFT, flattering exactly the requests a kill hurt — and
+        the tenant ``submitted``/``recovered`` counters are NOT bumped
+        (the source replica already counted them and stays in the merged
+        stats); inbound moves count under ``stats['migrated_in']``. The
+        default is the fresh-process form: stamps restart with the clock
+        and the counters are this server's to claim."""
         recovered = 0
         for uid in sorted(states):
             st = states[uid]
@@ -498,18 +530,32 @@ class PagedServer:
                 max_new_tokens=int(st.max_new_tokens),
                 eos_token_id=st.eos_token_id, tenant=st.tenant,
                 generated=[int(t) for t in st.generated],
-                t_submit=self.clock(),
+                t_submit=(
+                    st.t_submit
+                    if migrated_in and st.t_submit is not None
+                    else self.clock()
+                ),
+                t_first=st.t_first if migrated_in else None,
             )
             self._queue.append(req)
-            self._tenant(st.tenant)["submitted"] += 1
+            # re-open the request's lifecycle span on THIS timeline —
+            # extraction (or the crash) closed/lost the previous one, and
+            # _finish will end this span when the stream completes
+            self.tracer.begin_async("request", uid, f"req{uid}", tenant=st.tenant)
+            if not migrated_in:
+                self._tenant(st.tenant)["submitted"] += 1
             if self.journal is not None:
+                # re-seed with the Request's OWN stamps (not st's): they are
+                # consistent with this server's clock domain whichever path
+                # built the request
                 self.journal.append_submit(
                     uid, st.prompt, st.max_new_tokens, st.eos_token_id,
                     st.tenant, generated=st.generated,
+                    t_submit=req.t_submit, t_first=req.t_first,
                 )
             recovered += 1
         self._next_uid = max(self._next_uid, int(next_uid))
-        self.stats["recovered"] += recovered
+        self.stats["migrated_in" if migrated_in else "recovered"] += recovered
         if self.journal is not None:
             # the compaction (seeded submits + finished results) is durable
             # before the superseded pre-crash segments are dropped — this
@@ -517,6 +563,149 @@ class PagedServer:
             self.journal.sync()
             self.journal.retire_older_segments()
         return recovered
+
+    def extract_request(self, uid: int) -> Optional["JournaledRequest"]:
+        """Remove a live or queued request from THIS server and return its
+        replay state — the source half of a fleet migration
+        (``inference/fleet.py``): the target re-admits the state via
+        ``recover()``, re-prefills ``prompt + generated`` on the cold
+        chunk grid (the recompute-preemption machinery, ~free for shared
+        prompts under prefix caching), and the stream continues
+        byte-identically from its last emitted token. No journal record
+        is written here — the request's journal hand-off happens in
+        ``finalize_migration`` AFTER the target has durably re-seeded it,
+        so no crash instant leaves the request claimed by neither
+        journal. Returns None when the uid is not live here (already
+        finished or never admitted)."""
+        req = next((r for r in self._active if r.uid == uid), None)
+        if req is not None:
+            self.pool.free_slot(req.slot)
+            req.slot = None
+            req.pending = None
+            req.consumed = 0
+            self._active.remove(req)
+        else:
+            req = next((r for r in self._queue if r.uid == uid), None)
+            if req is None:
+                return None
+            self._queue.remove(req)
+        if self.drafter is not None:
+            self.drafter.drop(uid)
+        self.stats["migrated_out"] += 1
+        if self.tracer.enabled:
+            # close the request's lifecycle span on this timeline — the
+            # target replica's timeline picks the request up at recover
+            self.tracer.end_async(
+                "request", uid, f"req{uid}", migrated=True,
+                tokens=len(req.generated),
+            )
+        return JournaledRequest(
+            uid=req.uid,
+            prompt=np.asarray(req.prompt, np.int32),
+            max_new_tokens=int(req.max_new_tokens),
+            eos_token_id=req.eos_token_id,
+            tenant=req.tenant,
+            generated=[int(t) for t in req.generated],
+            t_submit=req.t_submit,
+            t_first=req.t_first,
+        )
+
+    def restore_request(self, state: "JournaledRequest") -> None:
+        """Inverse of ``extract_request`` for a migration that found no
+        target: re-queue the state on THIS server (stamps preserved — the
+        clock never changed) and undo the extraction's migration
+        accounting, since nothing actually moved."""
+        self.recover({state.uid: state}, 0, migrated_in=True)
+        self.stats["migrated_out"] -= 1
+        self.stats["migrated_in"] -= 1
+
+    def retain_migrated_claim(self, uid: int, state: "JournaledRequest") -> None:
+        """The request migrated to a JOURNAL-LESS target, which can never
+        durably claim it — so THIS journal must keep the claim (state as
+        of the migration) or a crash finds the request in neither journal
+        and its acked tokens are lost. The claim rides every compaction
+        until ``release_migrated_claim``; tokens the target emits after
+        the move were never durable anywhere, which is what running a
+        journal-less replica means."""
+        if self.journal is None:
+            return
+        self._foreign_claims[uid] = state
+
+    def release_migrated_claim(self, uid: int) -> None:
+        """The migrated-away request finished and its output was
+        delivered: disclaim it (durability no longer matters once the
+        caller holds the bytes), so a later replay cannot resurrect it."""
+        if self._foreign_claims.pop(uid, None) is None:
+            return
+        if self.journal is not None:
+            self.journal.append_migrate(uid)
+            self.journal.sync()
+            self._migrated_since_compact += 1
+            self._maybe_compact_migrated()
+
+    def _maybe_compact_migrated(self) -> None:
+        """Compact when migrated-out garbage outweighs the live state
+        still worth rewriting — the shared trigger for BOTH disclaim
+        paths (finalize_migration and release_migrated_claim), so journal
+        growth stays bounded even when every migration flows through
+        journal-less targets."""
+        if self._migrated_since_compact > len(self._queue) + len(self._active):
+            self.compact_journal()
+
+    def finalize_migration(self, uid: int) -> None:
+        """Source-side journal hand-off after a migration landed on the
+        target: append the migrated-out record (durable immediately — the
+        source must not resurrect the request on a later replay), then
+        compact only when the migrated-out garbage outweighs the live
+        state still worth rewriting. A drain of N requests therefore pays
+        O(N) total journal I/O (compactions at the halving points plus one
+        final at empty, which is also what keeps the drained journal at
+        ≤1 segment) instead of N full-state rewrites — and a single
+        rebalancing move off a busy replica costs one record + sync, not
+        a rewrite of every resident request."""
+        if self.journal is None:
+            return
+        self.journal.append_migrate(uid)
+        self.journal.sync()
+        self._migrated_since_compact += 1
+        self._maybe_compact_migrated()
+
+    def compact_journal(self) -> int:
+        """Re-seed this server's FULL current state into a fresh journal
+        segment and retire every older one (``journal.begin_compaction``):
+        live requests as seeded submits, unclaimed finished results as
+        byte-preserving submit+finish records (their original
+        prompt/budget split is gone at finish — the replayed result is the
+        output array verbatim, which is all a result needs). The live-
+        server form of the compaction ``recover()`` performs on restart;
+        ``finalize_migration`` triggers it when migrated-out garbage
+        outweighs live state, so journal growth stays bounded. Returns
+        the number of segments retired."""
+        if self.journal is None:
+            return 0
+        self._migrated_since_compact = 0
+        self.stats["journal_compactions"] += 1
+        self.journal.begin_compaction()
+        for st in self._foreign_claims.values():
+            # claims held for requests living on journal-less replicas
+            # survive the rewrite — dropping them here would silently
+            # break the neither-journal-loses-it invariant
+            self.journal.append_submit(
+                st.uid, st.prompt, st.max_new_tokens, st.eos_token_id,
+                st.tenant, generated=st.generated,
+                t_submit=st.t_submit, t_first=st.t_first,
+            )
+        for req in list(self._queue) + list(self._active):
+            self.journal.append_submit(
+                req.uid, req.prompt, req.max_new_tokens, req.eos_token_id,
+                req.tenant, generated=req.generated,
+                t_submit=req.t_submit, t_first=req.t_first,
+            )
+        for uid, out in self._results.items():
+            self.journal.append_submit(uid, out, 1, None, "default")
+            self.journal.append_finish(uid)
+        self.journal.sync()
+        return self.journal.retire_older_segments()
 
     def has_work(self) -> bool:
         return bool(self._queue or self._active)
@@ -1118,6 +1307,8 @@ class PagedServer:
         if req.t_first is None:
             req.t_first = self.clock()
             self.tracer.instant_async("request", req.uid, "first_token")
+            if self.journal is not None:
+                self.journal.append_first_token(req.uid, req.t_first)
         req.generated.append(token)
         req.pending = token
         self.stats["emitted_tokens"] += 1
@@ -1171,16 +1362,10 @@ class PagedServer:
     # --- observability ---------------------------------------------------
     @staticmethod
     def _percentiles(values) -> Dict:
-        """{count, mean, p50, p99} ms summary ({} count 0 when empty)."""
-        vals = np.asarray(values, np.float64)
-        if vals.size == 0:
-            return {"count": 0}
-        return {
-            "count": int(vals.size),
-            "mean": float(vals.mean()),
-            "p50": float(np.percentile(vals, 50)),
-            "p99": float(np.percentile(vals, 99)),
-        }
+        """{count, mean, p50, p99} ms summary ({} count 0 when empty) —
+        the one shared definition (the fleet router reports through it
+        too)."""
+        return percentile_summary(values)
 
     def finished_log(self):
         """Per-finished-request (tenant, ttft_ms, tpot_ms|None, n_tokens)

@@ -241,6 +241,26 @@ class MultiTenantServer:
     def result(self, uid: int):
         return self.server.result(uid)
 
+    def take_result(self, uid: int):
+        return self.server.take_result(uid)
+
+    def queued_count(self, tenant: Optional[str] = None) -> int:
+        return self.server.queued_count(tenant)
+
+    def live_count(self, tenant: Optional[str] = None) -> int:
+        return self.server.live_count(tenant)
+
+    def recover(self, states, next_uid: int = 0, migrated_in: bool = False) -> int:
+        # fleet migration / crash adoption lands on the wrapped server; the
+        # SLA policy sees the re-queued requests through its normal hooks
+        return self.server.recover(states, next_uid, migrated_in=migrated_in)
+
+    def extract_request(self, uid: int):
+        return self.server.extract_request(uid)
+
+    def finalize_migration(self, uid: int) -> None:
+        self.server.finalize_migration(uid)
+
     def finished_log(self):
         return self.server.finished_log()
 

@@ -34,6 +34,14 @@ is the shared timeline + metrics substrate underneath all of them:
   surfaces (compile / analysis / serve / checkpoint), and
   :meth:`ObservabilityHub.monitor_events` turns the current metrics into
   the ``(name, value, step)`` events the ``monitor/`` backends fan out.
+  The fleet router (``inference/fleet.py`` — the other module bound by
+  this file's never-import-jax contract, lint DS-R010) traces its own
+  span family on the same timeline (``fleet.step`` > ``fleet.replica_step``
+  per replica, plus ``fleet.route`` / ``fleet.migrate`` / ``fleet.drain``
+  and ``fleet.replica_dead`` / ``fleet.join`` instants) and registers a
+  ``fleet`` source via ``FleetRouter.attach_observability(hub)``, so one
+  report shows the router's supervision next to each replica's serving
+  phases.
 
 Overhead discipline: a disabled tracer's ``span()`` returns a shared no-op
 context manager (one attribute read + one call); an enabled span costs two
@@ -404,6 +412,35 @@ class Tracer:
 NULL_TRACER = Tracer(max_spans=1, enabled=False)
 """Shared disabled tracer: a safe default argument so instrumented code
 never branches on ``tracer is None``."""
+
+
+def percentile_summary(values) -> Dict[str, float]:
+    """``{count, mean, p50, p99}`` summary of a host-side sample
+    (``{'count': 0}`` when empty) — linear interpolation, matching
+    numpy's default percentile method. Lives here (stdlib-only, never
+    imports jax or numpy) so BOTH the scheduler's per-tenant latency
+    stats and the fleet router's merged stats share one definition —
+    the router is a DS-R010 host-only module that cannot import the
+    scheduler."""
+    vals = sorted(float(v) for v in values)
+    n = len(vals)
+    if n == 0:
+        return {"count": 0}
+
+    def pct(q: float) -> float:
+        if n == 1:
+            return vals[0]
+        pos = q / 100.0 * (n - 1)
+        lo = int(pos)
+        hi = min(lo + 1, n - 1)
+        return vals[lo] + (vals[hi] - vals[lo]) * (pos - lo)
+
+    return {
+        "count": n,
+        "mean": sum(vals) / n,
+        "p50": pct(50.0),
+        "p99": pct(99.0),
+    }
 
 
 # ---------------------------------------------------------------------------

@@ -23,6 +23,20 @@ Injection points (the canonical set — sites call ``chaos.point(NAME, ...)``):
   device dispatch/emits but before the journal flush
 * ``journal.append``       — right after a journal record batch reaches the
   OS (the classic torn-tail instant; pair with the ``truncate`` action)
+* ``fleet.replica_kill``   — at the top of one replica's turn inside the
+  fleet router's step loop (``inference/fleet.py``): the replica is its
+  own failure domain, so a ``raise`` here is ONE replica dying while the
+  router and the rest of the fleet survive (the router catches the kill
+  and re-routes the dead replica's live requests from its journal); the
+  ``exit`` action still kills the whole process — the ``-m slow``
+  restart-and-adopt case
+* ``fleet.mid_migration``  — inside a live request migration, after the
+  state left the source replica's memory but before the target durably
+  re-seeded it (the double-claim/no-claim window the target-journal-first
+  ordering and router-side dedup exist for)
+* ``fleet.mid_drain``      — between two migrations of an elastic drain:
+  the draining replica dies half-emptied and the remainder must re-route
+  from its journal with zero acked tokens dropped
 
 Actions:
 
@@ -72,6 +86,10 @@ POINTS = (
     "serve.mid_window",  # inside a multi-step window's host phase: the whole
     # window's tokens are buffered in the journal, none yet acked
     "journal.append",
+    "fleet.replica_kill",  # one replica's turn in the fleet step loop: raise =
+    # that replica dies (router survives + re-routes), exit = whole process
+    "fleet.mid_migration",  # state off the source, not yet durable on the target
+    "fleet.mid_drain",  # a draining replica dies between two migrations
 )
 
 _ACTIONS = ("raise", "exit", "truncate", "corrupt")

@@ -24,13 +24,20 @@ Time can be real (wall-clock replay, the bench/smoke mode) or virtual
 (``VirtualClock``: each server step costs a fixed dt and idle gaps jump
 instantly) — virtual replay is fully deterministic and is what the unit
 tests pin down.
+
+Fleet scope: ``replay`` drives anything with the server surface —
+including a ``FleetRouter`` (``inference/fleet.py``), whose ``clock``
+setter installs the virtual clock on every replica — and ``events``
+injects timed mid-trace actions (kill a replica, drain one, join a fresh
+one) at deterministic trace instants, which is how the fleet bench and
+tests measure p99 TTFT across a replica kill.
 """
 
 from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -155,15 +162,24 @@ def replay(
     max_steps: int = 1_000_000,
     starvation_tolerance: float = 0.10,
     keep_outputs: bool = True,
+    events: Optional[Sequence[Tuple[float, Callable]]] = None,
 ) -> Dict:
     """Replay ``trace`` into ``server`` and report SLA percentiles,
     per-tenant goodput vs budget shares, and prefix hit rate.
 
-    ``server`` is a ``PagedServer`` or ``MultiTenantServer`` (rejections —
-    ``submit`` returning None — are counted, not raised). With
-    ``clock=None`` the replay runs on the wall clock (arrivals in real
-    time, idle gaps slept); pass a ``VirtualClock`` (also installed on the
-    server) for deterministic virtual-time replay."""
+    ``server`` is a ``PagedServer`` or ``MultiTenantServer`` — or a
+    ``FleetRouter`` over several of them (rejections — ``submit``
+    returning None — are counted, not raised). With ``clock=None`` the
+    replay runs on the wall clock (arrivals in real time, idle gaps
+    slept); pass a ``VirtualClock`` (also installed on the server) for
+    deterministic virtual-time replay.
+
+    ``events`` is a list of ``(at_seconds, fn)`` timed actions fired once
+    when replay time passes ``at_seconds``, each called with the server —
+    the fleet-scope failure injections (kill a replica mid-trace, drain
+    one, join a fresh one) that make "p99 TTFT under replica kill" a
+    reproducible measurement. Events landing after the replay finishes
+    never fire; the report counts the fired ones."""
     wall = clock is None
     if wall:
         t0 = time.perf_counter()
@@ -180,11 +196,17 @@ def replay(
     offered: Dict[str, int] = {}
     rejected: Dict[str, int] = {}
     uid_by_index: Dict[int, int] = {}
+    pending_events = sorted(events or [], key=lambda e: e[0])
+    events_fired = 0
     i = 0
     steps = 0
     trace = list(trace)
     while i < len(trace) or server.has_work():
         now = now_fn()
+        while pending_events and pending_events[0][0] <= now:
+            _, fire = pending_events.pop(0)
+            fire(server)
+            events_fired += 1
         while i < len(trace) and trace[i].at <= now:
             r = trace[i]
             offered[r.tenant] = offered.get(r.tenant, 0) + 1
@@ -283,6 +305,7 @@ def replay(
     report = {
         "duration_s": duration,
         "steps": steps,
+        "events_fired": events_fired,
         "n_requests": len(trace),
         "n_rejected": sum(rejected.values()),
         "ttft_ms": stats.get("ttft_ms", {"count": 0}),

@@ -10,6 +10,7 @@ program and must name the argument that changed.
 
 from __future__ import annotations
 
+import os
 import warnings
 
 import jax
@@ -653,6 +654,116 @@ def test_green_ragged_serving_program_and_compile_gate():
     # exactly ONE dispatch per scheduler step
     assert sum(r["dispatches"] for r in stats.values()) == server.stats["ragged_steps"]
     # analysis green sweep: donation aliased, no host transfers, no upcasts
+    rep = run_program_passes(tel)
+    t = rep["totals"]
+    assert t["analysis_failures"] == 0 and t["violations"] == 0, rep
+    assert t["donation_verified"] is True
+    for name in rep["programs"]:
+        passes = rep["programs"][name]["passes"]
+        assert passes["host_transfer"]["ok"]
+        assert passes["dtype_promotion"]["ok"]
+        assert passes["donation"]["ok"]
+
+
+def test_green_fleet_serving():
+    """THE acceptance gate for fleet serving (ISSUE 12): a 3-replica
+    fleet serving a shifting mix — including a chaos replica kill
+    mid-serve — adds ZERO compiled programs beyond the single-replica
+    ragged budget (≤ 2 ``paged_*`` programs TOTAL across every replica:
+    uniform geometry + the shared program cache), never retraces after
+    its first wave, keeps the ragged one-dispatch-per-step contract on
+    every replica (dispatches/token unchanged vs a single replica —
+    telemetry reconciles with the summed scheduler counters), the router
+    itself is pure host code (lint DS-R010: no jax import in
+    ``inference/fleet.py``), and every compiled program verifies clean
+    under the donation / host-transfer / dtype passes."""
+    from deepspeed_tpu.analysis import run_program_passes
+    from deepspeed_tpu.analysis.source_lint import lint_paths
+    from deepspeed_tpu.inference.fleet import FleetRouter, ReplicaHandle
+    from deepspeed_tpu.inference.scheduler import (
+        PagedServer,
+        compiled_serving_programs,
+    )
+    from deepspeed_tpu.models import TransformerLM
+    from deepspeed_tpu.models.config import TransformerConfig
+    from deepspeed_tpu.utils import chaos as chaos_mod
+
+    cfg = TransformerConfig(
+        vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+        num_kv_heads=2, max_seq_len=64, norm="rmsnorm", position="rope",
+        activation="swiglu", use_bias=False, tie_embeddings=False,
+        flash_attention=False, dtype="float32",
+    )
+    model = TransformerLM(cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0, cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(0), toks)
+    tel = CompileTelemetry()
+
+    def replica():
+        return PagedServer(
+            cfg, params, page_size=8, max_slots=4, prefill_chunk=8,
+            attn_impl="xla", dtype=jnp.float32, telemetry=tel,
+            prefix_cache=True,
+        )
+
+    router = FleetRouter(
+        [ReplicaHandle(name=f"r{i}", server=replica()) for i in range(3)]
+    )
+    rs = np.random.RandomState(0)
+    waves = [
+        [rs.randint(0, 128, (int(n),)).astype(np.int32) for n in lens]
+        for lens in ([5, 7, 11], [19, 4, 22, 9], [13, 6])
+    ]
+    compiles_after_wave = []
+    for wi, wave in enumerate(waves):
+        if wi == 1:
+            # wave 2 serves across a replica kill: the survivors absorb
+            # the dead replica's requests without a single new program
+            chaos_mod.install(chaos_mod.ChaosSchedule(
+                [chaos_mod.ChaosRule("fleet.replica_kill", hit=4)]
+            ))
+        try:
+            outs = router.serve(wave, max_new_tokens=6)
+        finally:
+            chaos_mod.uninstall()
+        assert all(o is not None for o in outs)
+        compiles_after_wave.append(
+            sum(r["compiles"] for r in tel.stats().values())
+        )
+    fs = router.fleet_stats()
+    assert fs["replica_kills"] == 1, fs
+    assert fs["n_active"] == 2
+    assert fs["migrated_token_divergence"] == 0
+    stats = tel.stats()
+    assert all(n.startswith("paged_ragged_") for n in stats), stats.keys()
+    # THE gate: the whole 3-replica fleet compiles no more programs than
+    # one replica's ragged budget — replicas share the program cache
+    assert compiled_serving_programs(stats) <= 2, stats
+    # retrace guard: wave 1 compiled everything; the kill wave and the
+    # recovery wave added nothing
+    assert compiles_after_wave[1] == compiles_after_wave[0], compiles_after_wave
+    assert compiles_after_wave[2] == compiles_after_wave[0], compiles_after_wave
+    for name, rec in stats.items():
+        assert rec["compiles"] <= 1, f"{name} recompiled: {rec}"
+    # dispatches/token unchanged vs single replica: every replica still
+    # runs ONE ragged dispatch per non-empty scheduler step, and the
+    # fleet-summed telemetry reconciles exactly with the schedulers'
+    # own dispatch counters (the router adds zero device work; the dead
+    # replica's pre-kill dispatches stay in the merge)
+    merged = router.serve_stats()
+    inners = [h.inner for h in router.replicas.values()]
+    assert sum(r["dispatches"] for r in stats.values()) == merged["dispatches"]
+    assert merged["dispatches"] == sum(s.stats["dispatches"] for s in inners)
+    assert merged["dispatches"] == sum(s.stats["ragged_steps"] for s in inners)
+    # the router is pure host code: lint-enforced (DS-R010) on the real file
+    fleet_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))),
+        "deepspeed_tpu", "inference", "fleet.py",
+    )
+    findings = lint_paths([fleet_path])
+    assert [f.rule for f in findings] == [], [f.render() for f in findings]
+    # analysis green sweep over every program the fleet dispatched
     rep = run_program_passes(tel)
     t = rep["totals"]
     assert t["analysis_failures"] == 0 and t["violations"] == 0, rep

@@ -444,6 +444,51 @@ def test_r009_pragma_suppresses_and_is_error_severity():
     assert resolve_severity(f) == "error"
 
 
+def test_r010_jax_import_in_host_only_module_flagged():
+    """The fleet router and the tracer are declared pure host code: any
+    jax import form trips the rule there — and only there."""
+    for src in (
+        "import jax\n",
+        "import jax.numpy as jnp\n",
+        "from jax import numpy\n",
+        "from jax.sharding import NamedSharding\n",
+    ):
+        hits = [
+            f.rule
+            for f in lint_source(src, path="deepspeed_tpu/inference/fleet.py")
+        ]
+        assert hits == ["DS-R010"], (src, hits)
+    assert "DS-R010" in [
+        f.rule
+        for f in lint_source("import jax\n", path="deepspeed_tpu/profiling/tracer.py")
+    ]
+
+
+def test_r010_quiet_elsewhere_and_on_host_imports():
+    # jax imports are the norm everywhere else in the library
+    assert not lint_source(
+        "import jax\n", path="deepspeed_tpu/inference/scheduler.py"
+    )
+    # numpy / stdlib / journal imports in the host-only modules are fine
+    assert not lint_source(
+        "import numpy as np\nimport zlib\n"
+        "from deepspeed_tpu.inference.journal import RequestJournal\n",
+        path="deepspeed_tpu/inference/fleet.py",
+    )
+    # a deliberate (hypothetical) exception carries a pragma
+    assert not lint_source(
+        "import jax  # lint: allow(DS-R010)\n",
+        path="deepspeed_tpu/inference/fleet.py",
+    )
+
+
+def test_r010_fleet_module_actually_lints_clean():
+    """The real router module holds the contract (the gate's lint leg)."""
+    path = os.path.join(REPO, "deepspeed_tpu", "inference", "fleet.py")
+    findings = lint_paths([path])
+    assert [f.rule for f in findings] == [], [f.render() for f in findings]
+
+
 def test_severity_tests_path_is_warn_only():
     f = lint_source("import jax.numpy as jnp\nx = jnp.repeat(k_cache, 2)\n", path="tests/unit/foo.py")[0]
     assert f.rule == "DS-R001"

@@ -77,6 +77,22 @@ class TestJournalUnits:
         assert not states[1].finished and states[1].eos_token_id == 3
         assert states[1].tenant == "tenantB"
 
+    def test_replay_preserves_timing_stamps(self, tmp_path):
+        """The submit record's ts and the one-shot first-token record keep
+        TTFT honest across a live-fleet re-route: replay returns the
+        original stamps (absent records replay as None — a fresh process
+        must restamp against its own clock)."""
+        j = RequestJournal(str(tmp_path))
+        j.append_submit(0, np.asarray([1, 2], np.int32), 8, None, "default",
+                        t_submit=2.5)
+        j.append_first_token(0, 3.25)
+        j.append_emit(0, 7)
+        j.append_submit(1, np.asarray([4], np.int32), 4, None, "default")
+        j.sync()
+        states, _ = RequestJournal.replay(str(tmp_path))
+        assert states[0].t_submit == 2.5 and states[0].t_first == 3.25
+        assert states[1].t_submit is None and states[1].t_first is None
+
     def test_seeded_resubmit_replaces_state(self, tmp_path):
         """Recovery compaction: a later submit record with pre-seeded
         emissions resets the uid's state (old segments stay replayable)."""

@@ -61,6 +61,15 @@
 # ≤ 1/horizon via telemetry, ≤4-compiled-programs + retrace guards,
 # mid-window crash recovery + one-journal-sync-per-window, window-program
 # green sweep (donation through the lax.scan carry, 0 host transfers).
+# +serving fleet 2026-08-04 (test_fleet.py + fleet green gate + DS-R010
+# lint): replicated engines behind the FleetRouter — byte-identical
+# streams under replica kills at every fleet chaos point, live migration
+# mid-prefill/mid-decode with the acked prefix audited, drain-to-empty +
+# journal compaction, prefix-affinity-beats-random routing, SLA/goodput
+# across a mid-trace kill on the loadgen replay, circuit breaker,
+# prefill/decode role split, elasticity resize policy + journal-catch-up
+# join, fleet-adds-0-programs compile gate. The real kill -9
+# restart-and-adopt case is `-m slow`.
 cd "$(dirname "$0")/.." || exit 1
 sh tools/lint.sh || exit 1
 exec python -m pytest -q \
@@ -87,6 +96,7 @@ exec python -m pytest -q \
   tests/unit/inference/test_multistep_serving.py \
   tests/unit/inference/test_spec_decode.py \
   tests/unit/inference/test_traffic.py \
+  tests/unit/inference/test_fleet.py \
   tests/unit/ops/test_paged_attention.py \
   tests/unit/ops/test_op_builder.py \
   tests/unit/parallel/test_mesh.py \
