@@ -23,6 +23,8 @@ is freshest, but its line prints last):
   4. 32k-sequence training                (config 4, flash attention + remat)
   5. MoE inference vs dense               (config 5, expert dispatch overhead)
   6. Paged-KV continuous-batching serving (config 6, decode tokens/s/chip)
+  6b. Tensor-parallel sharded serving     (config 6b, tokens/s/chip at tp∈{1,2,4},
+                                           scaling efficiency + quantized comm bytes)
   7. Serving fleet under replica kill     (config 7, goodput vs single replica)
   1. GPT-2 125M ZeRO-1 training           (config 1, tokens/s/chip — headline, LAST)
 
@@ -68,6 +70,7 @@ METRICS = {
     "long_seq": "seq32k_flash_tokens_per_sec_per_chip",
     "moe_inference": "moe8x_top1_prefill_tokens_per_sec",
     "decode_serving": "decode_tokens_per_sec_per_chip",
+    "decode_serving_tp": "tp_decode_tokens_per_sec_per_chip",
     "fleet_serving": "fleet_goodput_tokens_per_sec",
 }
 
@@ -816,6 +819,132 @@ def bench_decode_serving():
     return rec
 
 
+def bench_decode_serving_tp():
+    """Config 6b (multi-chip): tensor-parallel sharded serving (ISSUE 13)
+    — the same ragged continuous-batching trace served at tp ∈ {1, 2, 4}
+    with the weights column/row-parallel and the paged KV pool sharded
+    over the kv-head axis. ``value`` is generated tokens/s **per chip** at
+    the widest tp arm (the number that must stay ~flat for linear
+    scaling); ``scaling_efficiency`` is (tokens/s/chip at tp) over the
+    tp=1 throughput per arm. On a CPU host every "chip" is a forced host
+    device, so absolute numbers are smoke-scale and the per-chip ratio is
+    dominated by the emulation — the structural fields
+    (``compiled_programs`` ≤ 2 on the mesh, ``quantized_comm`` wire-byte
+    accounting = fp/4) are the portable signal. ``quantized_value``
+    re-serves the widest arm with the EQuARX int8 all-reduce armed."""
+    # multi-device CPU smoke: the forced host-device count must land
+    # before this child process first initializes its backend
+    if CPU_ONLY and "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""
+    ):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=4"
+        )
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.analysis import run_program_passes
+    from deepspeed_tpu.inference.scheduler import PagedServer, compiled_serving_programs
+    from deepspeed_tpu.inference.tp import TPServing, serving_mesh
+    from deepspeed_tpu.models import TransformerLM
+    from deepspeed_tpu.models.config import TransformerConfig
+    from deepspeed_tpu.profiling.compile_telemetry import CompileTelemetry
+
+    if TINY:
+        n_req, prompt_len, max_new = 6, 12, 24
+        mcfg = TransformerConfig(
+            vocab_size=1024, hidden_size=128, num_layers=2, num_heads=8,
+            num_kv_heads=4, max_seq_len=128, norm="rmsnorm", position="rope",
+            activation="swiglu", use_bias=False, tie_embeddings=False,
+            flash_attention=False, dtype="float32",
+        )
+        paged = {"page_size": 8, "max_slots": 4, "prefill_chunk": 8}
+    else:
+        n_req, prompt_len, max_new = 16, 128, 128
+        mcfg = TransformerConfig(
+            vocab_size=32000, hidden_size=1024, num_layers=8, num_heads=16,
+            num_kv_heads=4, max_seq_len=1024, norm="rmsnorm", position="rope",
+            activation="swiglu", use_bias=False, tie_embeddings=False,
+        )
+        paged = {"page_size": 64, "max_slots": 8, "prefill_chunk": 128}
+
+    n_dev = len(jax.devices())
+    arms = [t for t in (1, 2, 4) if t <= n_dev and mcfg.num_kv_heads % t == 0]
+    dtype = jnp.float32 if TINY else jnp.bfloat16
+    model = TransformerLM(mcfg)
+    rs = np.random.RandomState(SEED)
+    prompts = [
+        rs.randint(0, mcfg.vocab_size, (prompt_len,)).astype(np.int32)
+        for _ in range(n_req)
+    ]
+    params = model.init(
+        jax.random.PRNGKey(SEED), np.stack(prompts)[:1]
+    )
+    budgets = [max(1, max_new - (i * max_new) // (2 * n_req)) for i in range(n_req)]
+
+    def timed_serve(server):
+        t0 = _time.perf_counter()
+        outs = server.serve(prompts, max_new_tokens=budgets)
+        gen = sum(len(o) - prompt_len for o in outs)
+        return gen / (_time.perf_counter() - t0)
+
+    def build(tp_degree, quantized=False):
+        tel = CompileTelemetry()
+        tp = (
+            None
+            if tp_degree == 1
+            else TPServing(mesh=serving_mesh(tp_degree), quantized_allreduce=quantized)
+        )
+        server = PagedServer(
+            mcfg, params, attn_impl="xla" if CPU_ONLY else "auto",
+            dtype=dtype, telemetry=tel, tp=tp, **paged,
+        )
+        return tel, server
+
+    arm_tps = {}
+    compiled = {}
+    for t in arms:
+        tel, server = build(t)
+        timed_serve(server)  # cold: compiles the (≤2) sharded programs
+        arm_tps[t] = timed_serve(server)
+        compiled[t] = compiled_serving_programs(tel.stats())
+    widest = arms[-1]
+    per_chip = arm_tps[widest] / widest
+    # quantized all-reduce arm at the widest tp + its static comm account
+    q_tel, q_server = build(widest, quantized=True)
+    timed_serve(q_server)
+    q_tps = timed_serve(q_server)
+    q_wire = q_fp_equiv = 0
+    if widest > 1:
+        q_rep = run_program_passes(q_tel, passes=["collectives"])
+        for prog in q_rep["programs"].values():
+            qs = prog["passes"]["collectives"]["summary"]["quantized"]
+            q_wire += qs["wire_bytes"]
+            q_fp_equiv += qs["fp_equiv_wire_bytes"]
+    return {
+        "metric": METRICS["decode_serving_tp"],
+        "value": round(per_chip, 1),
+        "unit": "tokens/s/chip",
+        "tp_degree": int(widest),
+        "tp_arms_tokens_per_sec": {str(t): round(v, 1) for t, v in arm_tps.items()},
+        # (tokens/s/chip at tp) / (tokens/s at tp=1): 1.0 = linear scaling
+        "scaling_efficiency": {
+            str(t): round((arm_tps[t] / t) / arm_tps[1], 4) for t in arms
+        },
+        "vs_baseline": round((arm_tps[widest] / widest) / arm_tps[1], 4),
+        "compiled_programs": int(compiled[widest]),
+        "quantized_value": round(q_tps / widest, 1),
+        # static per-scan-body wire bytes of the int8 exchanges, summed
+        # over the compiled sharded programs, + the exact fp-equivalent
+        # (= 4x: the EQuARX accounting identity the analysis gate asserts)
+        "quantized_comm_wire_bytes": int(q_wire),
+        "quantized_comm_fp_equiv_bytes": int(q_fp_equiv),
+    }
+
+
 def bench_fleet_serving():
     """Config 7: the serving fleet under a mid-trace replica kill
     (``inference/fleet.py``). Three SLA-scheduled replicas replay a
@@ -978,6 +1107,7 @@ CONFIGS = {
     "long_seq": (bench_long_seq, 360),
     "moe_inference": (bench_moe_inference, 300),
     "decode_serving": (bench_decode_serving, 330),
+    "decode_serving_tp": (bench_decode_serving_tp, 330),
     "fleet_serving": (bench_fleet_serving, 330),
 }
 HEADLINE = "gpt2_zero1"
@@ -1254,7 +1384,7 @@ def main():
     # child json + known-good store still hold the number then).
     try:
         for name in ("llama_zero3", "infinity", "long_seq", "moe_inference",
-                     "decode_serving", "fleet_serving"):
+                     "decode_serving", "decode_serving_tp", "fleet_serving"):
             emit(finalize(name, run_config(name)))
 
         # If the headline errored earlier but budget remains, give it one

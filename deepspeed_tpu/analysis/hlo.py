@@ -165,6 +165,118 @@ def collect_collectives(hlo_text: str) -> Dict[str, Dict[str, Any]]:
     return out
 
 
+# quantized wire dtypes: the EQuARX-style exchanges move int8 (or packed
+# sub-byte / f8) payloads — 1 byte on the wire where fp32 moves 4
+_QUANT_DTYPE_RE = re.compile(r"^([su](2|4|8)|f8e\w+)$")
+# replica group forms: explicit {{0,1,2,3},{4,5,6,7}}, iota [2,4]<=[8],
+# and the empty form {} (= one group of ALL participating devices)
+_GROUPS_FIRST_RE = re.compile(r"replica_groups=\{\{([\d,]+)\}")
+_GROUPS_IOTA_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]<=")
+_GROUPS_EMPTY_RE = re.compile(r"replica_groups=\{\s*\}")
+_NUM_PARTITIONS_RE = re.compile(r"num_partitions=(\d+)")
+
+
+def module_num_partitions(hlo_text: str) -> Optional[int]:
+    """``num_partitions`` from the module header — the world size the
+    empty ``replica_groups={}`` form implies."""
+    m = _NUM_PARTITIONS_RE.search(module_header(hlo_text))
+    return int(m.group(1)) if m else None
+
+
+def replica_group_size(attrs: str, world: Optional[int] = None) -> Optional[int]:
+    """Participants per replica group of a collective op line.
+    ``replica_groups={}`` (XLA's spelling for one group of every
+    participating device) resolves to ``world`` (the module's
+    num_partitions) when given. None when absent/unparseable —
+    best-effort contract."""
+    m = _GROUPS_FIRST_RE.search(attrs)
+    if m:
+        return len(m.group(1).split(","))
+    m = _GROUPS_IOTA_RE.search(attrs)
+    if m:
+        return int(m.group(2))
+    if _GROUPS_EMPTY_RE.search(attrs):
+        return world
+    return None
+
+
+def wire_factor(op: str, group: Optional[int]) -> float:
+    """Per-device wire bytes of a collective as a multiple of its payload
+    bytes, under the standard ring/bidirectional cost model: an all-reduce
+    moves its payload twice (reduce-scatter + all-gather phases, each
+    ``(g-1)/g``); gather/scatter/exchange ops move it once. The factor is
+    what turns the static payload schedule into the comm cost model PERF.md
+    budgets (and what makes "int8 exchange = fp all-reduce / 4" an exact
+    accounting identity: 2·(g-1)/g·4N fp bytes vs 2·(g-1)/g·N int8 bytes)."""
+    if group is None or group <= 1:
+        return 0.0 if group == 1 else 1.0
+    frac = (group - 1) / group
+    if op == "all-reduce":
+        return 2.0 * frac
+    if op in ("all-gather", "reduce-scatter", "all-to-all", "collective-broadcast"):
+        return frac
+    return 1.0  # collective-permute and anything unrecognized: one hop
+
+
+def _payload_shapes(shape_str: str, is_start: bool):
+    """(dtype, dims) payload pairs of one collective's shape string, with
+    the async ``-start`` operand half trimmed per
+    ``async_start_result_bytes``'s convention."""
+    shapes = _SHAPE_RE.findall(shape_str)
+    if is_start:
+        while shapes and shapes[-1][0] in ("u32", "s32") and not shapes[-1][1]:
+            shapes = shapes[:-1]
+        if len(shapes) >= 2 and len(shapes) % 2 == 0:
+            shapes = shapes[len(shapes) // 2 :]
+    return shapes
+
+
+def collect_collective_details(hlo_text: str) -> List[Dict[str, Any]]:
+    """Per-occurrence collective records with dtype-aware byte accounting:
+    ``{op, bytes, wire_bytes, quantized_bytes, quantized_wire_bytes,
+    fp_equiv_wire_bytes, group}``. ``bytes`` matches
+    ``collect_collectives``'s payload accounting; ``wire_bytes`` applies
+    the per-device ring cost model (``wire_factor``); the ``quantized_*``
+    fields isolate sub-byte/int8/f8 payloads (the EQuARX exchanges) and
+    ``fp_equiv_wire_bytes`` prices the same element count at fp32 — the
+    comparison the quantized-comms acceptance gate asserts."""
+    out: List[Dict[str, Any]] = []
+    world = module_num_partitions(hlo_text)
+    for line in hlo_text.splitlines():
+        m = _COLLECTIVE_RE.search(line)
+        if not m:
+            continue
+        shape_str, op, suffix = m.group(1), m.group(2), m.group(3)
+        if suffix == "-done":
+            continue
+        shapes = _payload_shapes(shape_str, suffix == "-start")
+        group = replica_group_size(line, world=world)
+        wf = wire_factor(op, group)
+        rec = {
+            "op": op,
+            "group": group,
+            "bytes": 0,
+            "wire_bytes": 0.0,
+            "quantized_bytes": 0,
+            "quantized_wire_bytes": 0.0,
+            "fp_equiv_wire_bytes": 0.0,
+        }
+        for dtype, dims in shapes:
+            n = 1
+            if dims:
+                for d in dims.split(","):
+                    n *= int(d)
+            b = n * dtype_bytes(dtype)
+            rec["bytes"] += b
+            rec["wire_bytes"] += b * wf
+            if _QUANT_DTYPE_RE.match(dtype):
+                rec["quantized_bytes"] += b
+                rec["quantized_wire_bytes"] += b * wf
+                rec["fp_equiv_wire_bytes"] += n * 4 * wf
+        out.append(rec)
+    return out
+
+
 class HloInstruction:
     """One parsed op line of an HLO computation."""
 

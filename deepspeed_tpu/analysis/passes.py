@@ -482,10 +482,36 @@ def collectives_pass(
     cfg = config or {}
     budget = cfg.get("collective_budget_bytes")
     res = PassResult()
-    ops = hlo_parse.collect_collectives(art.hlo_text)
+    # ONE line scan: the per-occurrence detail records carry the same
+    # payload-byte accounting collect_collectives defined, so the legacy
+    # per-op aggregate folds out of them instead of re-parsing the module
+    details = hlo_parse.collect_collective_details(art.hlo_text)
+    ops: Dict[str, Dict[str, Any]] = {}
+    for d in details:
+        rec = ops.setdefault(d["op"], {"count": 0, "bytes": 0})
+        rec["count"] += 1
+        rec["bytes"] += d["bytes"]
     total_bytes = sum(r["bytes"] for r in ops.values())
     total_count = sum(r["count"] for r in ops.values())
     res.summary = {"ops": ops, "total_bytes": total_bytes, "total_count": total_count}
+    # dtype-aware wire accounting (ISSUE 13: quantized TP comms): the ring
+    # cost model per occurrence, with int8/f8 payloads — the EQuARX-style
+    # quantized all-reduce exchanges — isolated and priced against their
+    # fp32 equivalent. Bytes on the wire reflect the QUANTIZED dtype; the
+    # fp_equiv comparison is exact (2·(g-1)/g·N int8 vs ·4N fp bytes = /4).
+    wire_total = sum(d["wire_bytes"] for d in details)
+    q_count = sum(1 for d in details if d["quantized_bytes"])
+    q_bytes = sum(d["quantized_bytes"] for d in details)
+    q_wire = sum(d["quantized_wire_bytes"] for d in details)
+    q_fp_wire = sum(d["fp_equiv_wire_bytes"] for d in details)
+    res.summary["wire_bytes"] = int(round(wire_total))
+    res.summary["quantized"] = {
+        "count": q_count,
+        "bytes": q_bytes,
+        "wire_bytes": int(round(q_wire)),
+        "fp_equiv_wire_bytes": int(round(q_fp_wire)),
+        "wire_reduction": (q_fp_wire / q_wire) if q_wire else 0.0,
+    }
     if budget is not None and total_bytes > int(budget):
         res.violations.append(
             Violation(
@@ -494,6 +520,21 @@ def collectives_pass(
                 f"static collective payload {total_bytes} bytes/device exceeds "
                 f"the configured budget {int(budget)}",
                 details={"total_bytes": total_bytes, "budget": int(budget), "ops": ops},
+            )
+        )
+    q_budget = cfg.get("quantized_budget_bytes")
+    if q_budget is not None and q_wire > int(q_budget):
+        res.violations.append(
+            Violation(
+                "collectives",
+                art.name,
+                f"quantized collective wire payload {int(round(q_wire))} "
+                f"bytes/device exceeds the configured quantized budget "
+                f"{int(q_budget)}",
+                details={
+                    "quantized_wire_bytes": int(round(q_wire)),
+                    "budget": int(q_budget),
+                },
             )
         )
     return res
@@ -620,6 +661,10 @@ def overlap_pass(art: ProgramArtifact, config: Optional[Dict[str, Any]] = None) 
 
     n_hidden = n_exposed = hidden_bytes = exposed_bytes = async_pairs = 0
     loop_total = 0
+    # quantized loop collectives (the EQuARX exchanges of a quantized TP
+    # serving program) verified hidden — the gate asserts the quantized
+    # comm schedule was actually SEEN on the hot path, not just absent
+    loop_quantized = loop_quantized_hidden = 0
     loop_exposed: List[Dict[str, Any]] = []
     for cname, instrs in comps.items():
         colls = [
@@ -658,8 +703,18 @@ def overlap_pass(art: ProgramArtifact, config: Optional[Dict[str, Any]] = None) 
                 hidden = any(
                     x.name not in desc and x.name not in anc for x in compute
                 )
+            quantized = any(
+                hlo_parse._QUANT_DTYPE_RE.match(dtype)
+                for dtype, _ in hlo_parse._payload_shapes(
+                    c.shape_str, c.suffix == "-start"
+                )
+            )
             if in_loop:
                 loop_total += 1
+                if quantized:
+                    loop_quantized += 1
+                    if hidden:
+                        loop_quantized_hidden += 1
             if hidden:
                 n_hidden += 1
                 hidden_bytes += nbytes
@@ -680,6 +735,8 @@ def overlap_pass(art: ProgramArtifact, config: Optional[Dict[str, Any]] = None) 
         "exposed_bytes": exposed_bytes,
         "async_pairs": async_pairs,
         "loop_collectives": loop_total,
+        "loop_quantized": loop_quantized,
+        "loop_quantized_hidden": loop_quantized_hidden,
         "loop_exposed": loop_exposed,
         "overlap_verified": verified,
     }

@@ -117,10 +117,11 @@ _TMPISH = re.compile(r"(tmp|temp|staging|partial|scratch)", re.IGNORECASE)
 _POOL_ATTRS = {
     "page_table", "seq_lens", "cache", "_free", "_free_slots", "_owned",
     "_refcount", "_hash_index", "_page_hash", "_cached", "_chain_keys",
+    "kv_sharding",
 }
 _POOL_DISTINCT = {
     "page_table", "_free_slots", "_refcount", "_hash_index", "_page_hash",
-    "_chain_keys",
+    "_chain_keys", "kv_sharding",
 }
 _POOLISH = re.compile(r"pool", re.IGNORECASE)
 _POOL_CLASS = re.compile(r"Pool$")
@@ -143,10 +144,16 @@ _SCAN_COLLECTIVES = {"all_gather", "psum"}
 # class qualifies only when it BOTH matches the name pattern and defines a
 # serving-specific round method, so host-only training-side schedulers
 # (curriculum / random-LTD / compression `step()`s) stay out of scope.
+# The ragged/window/TP family is in scope too (ISSUE 13): the sharded
+# serving path runs the SAME one-fetch-per-dispatch budget, and a host
+# transfer hidden in a tp/ragged step method costs every chip in the mesh.
 _HOT_CLASS = re.compile(r"(Server|Scheduler)$")
-_SERVING_FN = re.compile(r"^_?((plain_)?(decode|prefill|verify|spec)_(step|round)|serve)$")
+_SERVING_FN = re.compile(
+    r"^_?((plain_)?(decode|prefill|verify|spec|ragged|tp)_(step|round|window)|serve)$"
+)
 _HOT_FN = re.compile(
-    r"^_?((plain_)?(decode|prefill|verify|spec)_(step|round)|step|run|serve)$"
+    r"^_?((plain_)?(decode|prefill|verify|spec|ragged|tp)_(step|round|window)"
+    r"|settle_(ragged|window)_rows|settle_spec_row|step|run|serve)$"
 )
 _NP_CASTS = ("np.asarray", "np.array", "numpy.asarray", "numpy.array", "onp.asarray")
 

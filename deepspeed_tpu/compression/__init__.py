@@ -13,3 +13,10 @@ from deepspeed_tpu.compression.basic_layer import (
     row_pruning_mask,
     sparse_pruning_mask,
 )
+from deepspeed_tpu.compression.int8 import (
+    QuantizedTensor,
+    dequantize,
+    qmatmul,
+    quantize_params_int8,
+    quantize_weight_int8,
+)

@@ -1,0 +1,134 @@
+"""Int8 weight quantization for sharded serving (ISSUE 13).
+
+DeepSpeed-Inference (PAPERS.md, arXiv 2207.00032) serves large models with
+int8 weights and fp accumulation: HBM (and, under tensor parallelism, the
+weight-shard footprint per chip) drops 2-4x while the matmul epilogue
+dequantizes at no extra memory traffic. The TPU-native translation here:
+
+* a weight is stored as a :class:`QuantizedTensor` — int8 codes in the
+  weight's own shape plus **per-output-channel** fp32 scales (``keepdims``
+  on the contraction axis, so a stacked ``[L, in, out]`` layer weight
+  scans exactly like its unquantized form);
+* ``scale = max|w| / 127`` per output channel, ``q = round(w / scale)`` —
+  the roundtrip error is elementwise ``|w - q*scale| <= scale / 2
+  = max|w_channel| / 254`` (the documented tolerance bound the int8
+  serving tests assert);
+* :func:`qmatmul` fuses dequantization into the matmul epilogue:
+  ``(h @ q) * scale`` — one multiply per output element, never a
+  materialized dequantized copy of the weight. Because the scales are
+  per **output** channel they commute with a tensor-parallel row split:
+  each chip's partial sum is already scaled, so partials add (and
+  all-reduce) correctly without touching the scales.
+
+``QuantizedTensor`` is a NamedTuple and therefore a pytree: quantized
+param trees flow through ``jax.device_put`` / ``shard_map`` specs like any
+other tree (``inference/tp.py`` emits a matching spec pair per quantized
+leaf — codes shard like the weight, scales follow the output channels).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, FrozenSet, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+# the matmul weights of the flagship serving layout (models/transformer.py
+# param names): attention projections, FFN, and the LM head. Embeddings
+# stay exact — their use is a gather, and a tied head would silently
+# quantize the logits path twice.
+DEFAULT_QUANT_LEAVES: FrozenSet[str] = frozenset(
+    {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_in", "w_out", "lm_head"}
+)
+
+_SCALE_FLOOR = 1e-30  # an all-zero channel must not divide by zero
+
+
+class QuantizedTensor(NamedTuple):
+    """Int8 weight codes + per-output-channel fp32 scales.
+
+    ``q`` has the original weight's shape; ``scale`` keeps the contraction
+    (second-to-last) axis as a singleton so both leaves slice identically
+    under a leading scan/stack dim."""
+
+    q: jax.Array  # int8, the weight's shape
+    scale: jax.Array  # float32, weight shape with axis -2 reduced to 1
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+
+def quantize_weight_int8(w) -> QuantizedTensor:
+    """Per-output-channel symmetric int8 quantization of a matmul weight
+    ``[..., in, out]``. Elementwise roundtrip error is bounded by
+    ``scale/2 = max|w_channel|/254``."""
+    w = jnp.asarray(w)
+    if w.ndim < 2:
+        raise ValueError(f"quantize_weight_int8 needs a matmul weight, got ndim {w.ndim}")
+    scale = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2, keepdims=True) / 127.0
+    scale = jnp.maximum(scale, _SCALE_FLOOR)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127).astype(jnp.int8)
+    return QuantizedTensor(q=q, scale=scale)
+
+
+def dequantize(w: QuantizedTensor, dtype=jnp.float32):
+    return (w.q.astype(jnp.float32) * w.scale).astype(dtype)
+
+
+def qmatmul(h, w):
+    """``h @ w`` with dequantization fused into the epilogue when ``w`` is
+    quantized — the one matmul entry every serving projection site goes
+    through, so int8 weights ride the same programs as fp weights. Plain
+    arrays take the exact path the call sites used before."""
+    if isinstance(w, QuantizedTensor):
+        out = h @ w.q.astype(h.dtype)
+        # scale is [..., 1, out]; the product lost the contraction axis
+        return out * w.scale[..., 0, :].astype(h.dtype)
+    return h @ w.astype(h.dtype)
+
+
+def slice_out_channels(w, start: int, size: int):
+    """Slice a weight's output-channel (last) axis — the tensor-parallel
+    chunked row-matmul splits its all-reduces along it. Quantized weights
+    slice codes and scales in lockstep."""
+    if isinstance(w, QuantizedTensor):
+        return QuantizedTensor(
+            q=jax.lax.slice_in_dim(w.q, start, start + size, axis=-1),
+            scale=jax.lax.slice_in_dim(w.scale, start, start + size, axis=-1),
+        )
+    return jax.lax.slice_in_dim(w, start, start + size, axis=-1)
+
+
+def quantize_params_int8(params: Any, leaves: FrozenSet[str] = DEFAULT_QUANT_LEAVES) -> Any:
+    """Quantize the named matmul weights of a serving param tree to int8
+    (everything else — embeddings, norms, biases — stays exact). Quantize
+    BEFORE tensor-parallel sharding: the per-output-channel scales are
+    then global, so row-parallel partial sums dequantize consistently on
+    every chip."""
+
+    def walk(tree):
+        if isinstance(tree, QuantizedTensor):
+            return tree  # already quantized
+        if isinstance(tree, dict):
+            out: Dict[str, Any] = {}
+            for k, v in tree.items():
+                if (
+                    k in leaves
+                    and not isinstance(v, (dict, list, tuple))
+                    and jnp.ndim(v) >= 2
+                    and jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating)
+                ):
+                    out[k] = quantize_weight_int8(v)
+                else:
+                    out[k] = walk(v)
+            return out
+        if isinstance(tree, (list, tuple)):
+            return type(tree)(walk(v) for v in tree)
+        return tree
+
+    return walk(params)

@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deepspeed_tpu.compression.int8 import qmatmul
 from deepspeed_tpu.models.config import TransformerConfig
 from deepspeed_tpu.models.transformer import _norm, _rope
 
@@ -53,13 +54,16 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: int, dtype=None) -> 
 
 def _layer_project_qkv(cfg: TransformerConfig, p, h):
     """Norm + qkv projection for a [B, T, H] slab (same ops as
-    models/transformer.py _layer)."""
+    models/transformer.py _layer). Column-parallel under TP serving: the
+    weights arrive pre-sliced by shard_map (cfg is then the LOCAL view),
+    and ``qmatmul`` fuses int8 dequantization when the weights are
+    quantized (``compression/int8.py``)."""
     B, T, _ = h.shape
     NH, NKV, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
     hn = _norm(h, p["attn_norm_scale"], p.get("attn_norm_bias"), cfg.norm, cfg.norm_eps)
-    q = hn @ p["wq"].astype(hn.dtype)
-    k = hn @ p["wk"].astype(hn.dtype)
-    v = hn @ p["wv"].astype(hn.dtype)
+    q = qmatmul(hn, p["wq"])
+    k = qmatmul(hn, p["wk"])
+    v = qmatmul(hn, p["wv"])
     if cfg.qkv_bias:
         q = q + p["bq"].astype(hn.dtype)
         k = k + p["bk"].astype(hn.dtype)
@@ -71,16 +75,16 @@ def _layer_project_qkv(cfg: TransformerConfig, p, h):
     )
 
 
-def _ffn_body(cfg: TransformerConfig, p, x, norm_scale, norm_bias):
+def _ffn_body(cfg: TransformerConfig, p, x, norm_scale, norm_bias, tp=None):
     """norm → ffn, NO residual — callers place the residual per architecture."""
     from deepspeed_tpu.moe.experts import apply_dense_ffn
 
     h = _norm(x, norm_scale, norm_bias, cfg.norm, cfg.norm_eps)
-    return apply_dense_ffn(p, h, cfg.activation)
+    return apply_dense_ffn(p, h, cfg.activation, tp=tp)
 
 
-def _layer_mlp(cfg: TransformerConfig, p, x):
-    return x + _ffn_body(cfg, p, x, p["mlp_norm_scale"], p.get("mlp_norm_bias"))
+def _layer_mlp(cfg: TransformerConfig, p, x, tp=None):
+    return x + _ffn_body(cfg, p, x, p["mlp_norm_scale"], p.get("mlp_norm_bias"), tp=tp)
 
 
 def _softmax_scale(cfg, head_dim: int) -> float:
@@ -91,12 +95,18 @@ def _softmax_scale(cfg, head_dim: int) -> float:
     )
 
 
-def _post_attention(cfg, p, x, attn):
+def _post_attention(cfg, p, x, attn, tp=None):
     """Output projection + residual placement + mlp — shared tail of every
     cached-attention layer (dense and paged), so the two decode paths can
-    never drift on the residual architecture."""
+    never drift on the residual architecture. Under TP serving the output
+    projection is row-parallel: each chip holds its heads' slice of
+    ``wo``, the partial sums meet in ``tp.row_matmul``'s (chunked,
+    optionally quantized) all-reduce, and the bias — replicated — is
+    added exactly once, after the reduce."""
     B, T = x.shape[:2]
-    attn = attn.reshape(B, T, cfg.num_heads * cfg.head_dim) @ p["wo"].astype(x.dtype)
+    a = attn.reshape(B, T, cfg.num_heads * cfg.head_dim)
+    attn = (tp.row_matmul(a, p["wo"]) if tp is not None else qmatmul(a, p["wo"]))
+    attn = attn.astype(x.dtype)
     if cfg.use_bias:
         attn = attn + p["bo"].astype(x.dtype)
     if cfg.parallel_residual:
@@ -106,9 +116,9 @@ def _post_attention(cfg, p, x, attn):
         norm_bias = (
             p.get("attn_norm_bias") if cfg.shared_parallel_norm else p.get("mlp_norm_bias")
         )
-        return x + attn + _ffn_body(cfg, p, x, norm_scale, norm_bias)
+        return x + attn + _ffn_body(cfg, p, x, norm_scale, norm_bias, tp=tp)
     x = x + attn
-    return _layer_mlp(cfg, p, x)
+    return _layer_mlp(cfg, p, x, tp=tp)
 
 
 def _cached_attention(cfg, q, k_cache, v_cache, q_positions, kv_len_mask, kv_len=None):
@@ -193,13 +203,17 @@ def _forward_with_cache(cfg, params, tokens, cache: KVCache, start_pos):
 
 
 def _final_logits(cfg, params, x):
+    """Final norm + LM head. Under TP serving with an untied vocab-sharded
+    head the returned logits are each chip's LOCAL vocab slice — the
+    builders resolve greedy tokens through ``tp.argmax`` (global-first-max
+    semantics), so full logits never gather."""
     x = _norm(
         x, params["final_norm_scale"], params.get("final_norm_bias"), cfg.norm, cfg.norm_eps
     )
     if cfg.tie_embeddings:
         logits = x @ params["embed"]["tokens"].astype(x.dtype).T
     else:
-        logits = x @ params["lm_head"].astype(x.dtype)
+        logits = qmatmul(x, params["lm_head"])
         if cfg.lm_head_bias:
             logits = logits + params["lm_head_bias"].astype(logits.dtype)
     return logits
@@ -591,8 +605,27 @@ def _program_name(kind: str, rows: int, width: int) -> str:
 _paged_program_cache: Dict[Tuple, Any] = {}
 
 
-def _paged_program_key(name, cfg, page_size, attn_impl, telemetry) -> Tuple:
-    return (name, _cfg_key(cfg), int(page_size), attn_impl, _telemetry_uid(telemetry))
+def _paged_program_key(name, cfg, page_size, attn_impl, telemetry, tp=None) -> Tuple:
+    return (
+        name, _cfg_key(cfg), int(page_size), attn_impl, _telemetry_uid(telemetry),
+        None if tp is None else tp.cache_key(),
+    )
+
+
+def _tp_suffix(tp) -> str:
+    """Program-name suffix for tensor-parallel builds: a shard_map-wrapped
+    program is a different executable from the single-chip one even at the
+    same (rows, width), and telemetry must not merge their counters — so
+    every knob that changes the compiled schedule (degree, quantized
+    comms, int8 weights, non-default comm chunking) marks the name."""
+    if tp is None:
+        return ""
+    return (
+        f"_tp{tp.degree}"
+        + ("q" if tp.quantized_allreduce else "")
+        + ("w8" if tp.quantized_weights else "")
+        + (f"c{tp.comm_chunks}" if tp.comm_chunks != 2 else "")
+    )
 
 
 def _accepted_prefix(tokens, greedy, n_drafts):
@@ -632,7 +665,7 @@ def _scatter_pages(pages_l, vals, page_table, positions, page_size, valid=None):
 
 def _paged_forward(cfg, params, tokens, k_pages, v_pages, page_table, positions_b,
                    attn_lens, attn_impl, write_valid=None, prefill_kv_lens=None,
-                   ragged_q_lens=None):
+                   ragged_q_lens=None, tp=None):
     """Forward [B, T] tokens against the paged cache: scatter each token's
     k/v into its page, then attend — single-token rows (T == 1) through the
     paged decode kernel with live lengths ``attn_lens``, chunks through the
@@ -642,8 +675,12 @@ def _paged_forward(cfg, params, tokens, k_pages, v_pages, page_table, positions_
     each row's live kv prefix (the verify program's pad-slot safety).
     ``ragged_q_lens`` ([B]) switches the attention to the unified ragged
     entry (mixed prefill/decode/verify rows, per-row metadata — the
-    one-program serving step). Returns (logits [B, T, V], new_k_pages,
-    new_v_pages)."""
+    one-program serving step). ``tp`` (a ``inference/tp.py:TPServing``)
+    marks the body as running INSIDE shard_map on a tensor-parallel mesh:
+    ``cfg`` is then the local per-shard view (heads and kv pages sliced on
+    the head axes), the row-parallel projections all-reduce through the
+    context, and the returned logits may be the local vocab slice.
+    Returns (logits [B, T, V], new_k_pages, new_v_pages)."""
     from deepspeed_tpu.ops.transformer.paged_attention import (
         paged_decode_attention,
         paged_prefill_attention,
@@ -684,7 +721,7 @@ def _paged_forward(cfg, params, tokens, k_pages, v_pages, page_table, positions_
                 q, kp_l, vp_l, page_table, positions_b, scale=scale,
                 kv_lens=prefill_kv_lens,
             )
-        x = _post_attention(cfg, p, x, attn)
+        x = _post_attention(cfg, p, x, attn, tp=tp)
         return x, (kp_l, vp_l)
 
     x, (new_k, new_v) = jax.lax.scan(layer_step, x, (params["layers"], k_pages, v_pages))
@@ -824,7 +861,7 @@ def build_paged_verify_step(cfg, bucket: int, K: int, page_size: int,
 
 
 def build_ragged_multistep(cfg, rows: int, width: int, horizon: int, page_size: int,
-                           attn_impl: str = "auto", telemetry=None):
+                           attn_impl: str = "auto", telemetry=None, tp=None):
     """N plain-decode rounds in ONE dispatch: a ``lax.scan`` of ``horizon``
     iterations of the ragged step body, so the host dispatch gap, packing,
     and journal syncs are paid once per WINDOW instead of once per token.
@@ -871,12 +908,13 @@ def build_ragged_multistep(cfg, rows: int, width: int, horizon: int, page_size: 
             f"multi-step window needs rows >= 1 and horizon >= 2, got "
             f"{rows} rows x horizon {horizon}"
         )
-    name = f"{_program_name('multistep', rows, width)}_n{int(horizon)}"
-    key = _paged_program_key(name, cfg, page_size, attn_impl, telemetry)
+    name = f"{_program_name('multistep', rows, width)}_n{int(horizon)}" + _tp_suffix(tp)
+    key = _paged_program_key(name, cfg, page_size, attn_impl, telemetry, tp)
     fn = _paged_program_cache.get(key)
     if fn is not None:
         return fn
     N = int(horizon)
+    run_cfg = cfg if tp is None else tp.local_cfg(cfg)
 
     def _window(params, tokens, k_pages, v_pages, page_table, lengths, live,
                 eos_ids, budgets):
@@ -885,11 +923,14 @@ def build_ragged_multistep(cfg, rows: int, width: int, horizon: int, page_size: 
             q_lens = alive.astype(jnp.int32)  # [R]: 1 live, 0 frozen/dead
             kv_lens = jnp.where(alive, lens + 1, 0)
             logits, kp, vp = _paged_forward(
-                cfg, params, tok[:, None], kp, vp, page_table, lens[:, None],
+                run_cfg, params, tok[:, None], kp, vp, page_table, lens[:, None],
                 None, attn_impl, write_valid=alive[:, None],
-                prefill_kv_lens=kv_lens, ragged_q_lens=q_lens,
+                prefill_kv_lens=kv_lens, ragged_q_lens=q_lens, tp=tp,
             )
-            nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+            nxt = (
+                tp.argmax(logits[:, -1, :]) if tp is not None
+                else jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+            )
             out_tok = jnp.where(alive, nxt, -1)
             emitted = emitted + q_lens
             lens = lens + q_lens
@@ -909,13 +950,14 @@ def build_ragged_multistep(cfg, rows: int, width: int, horizon: int, page_size: 
         packed = jnp.concatenate([emitted[:, None], toks.T], axis=1)  # [R, 1+N]
         return packed, kp, vp
 
-    fn = _jit(_window, telemetry, name, donate_argnums=(2, 3))
+    body = _window if tp is None else tp.shard_program(_window, n_args=9)
+    fn = _jit(body, telemetry, name, donate_argnums=(2, 3))
     _paged_program_cache[key] = fn
     return fn
 
 
 def build_ragged_step(cfg, rows: int, width: int, page_size: int,
-                      attn_impl: str = "auto", telemetry=None):
+                      attn_impl: str = "auto", telemetry=None, tp=None):
     """THE one serving program: a ``rows × width`` ragged step that handles
     mixed prefill-chunk, decode, and verify rows in a single dispatch.
 
@@ -949,17 +991,27 @@ def build_ragged_step(cfg, rows: int, width: int, page_size: int,
     in as array contents, shifting traffic NEVER retraces: the scheduler
     compiles at most two widths of this program (decode/verify width and
     the mixed width covering prefill chunks) for an entire serve.
+
+    With ``tp`` (a ``inference/tp.py:TPServing``) the SAME body runs under
+    ``shard_map`` on the tensor-parallel mesh: weights and kv pages ride
+    in sharded (column/row-parallel projections, kv-head-sliced pools),
+    the per-layer row-parallel all-reduces are explicit (chunked for
+    overlap, optionally EQuARX-quantized), and the greedy/accepted-prefix
+    resolution uses the global argmax — so the packed host fetch, the
+    one-dispatch-per-step contract, the page donation, and the ≤2-program
+    budget are all unchanged on the mesh.
     """
     if cfg.position == "alibi":
         raise NotImplementedError("paged serving does not support alibi attention biases")
     if rows < 1 or width < 1:
         raise ValueError(f"ragged step needs rows >= 1 and width >= 1, got {rows}x{width}")
-    name = _program_name("ragged", rows, width)
-    key = _paged_program_key(name, cfg, page_size, attn_impl, telemetry)
+    name = _program_name("ragged", rows, width) + _tp_suffix(tp)
+    key = _paged_program_key(name, cfg, page_size, attn_impl, telemetry, tp)
     fn = _paged_program_cache.get(key)
     if fn is not None:
         return fn
     W = int(width)
+    run_cfg = cfg if tp is None else tp.local_cfg(cfg)
 
     def _step(params, tokens, k_pages, v_pages, page_table, lengths, q_lens):
         offs = jnp.arange(W, dtype=jnp.int32)
@@ -967,17 +1019,21 @@ def build_ragged_step(cfg, rows: int, width: int, page_size: int,
         valid = offs[None, :] < q_lens[:, None]
         kv_lens = jnp.where(q_lens > 0, lengths + q_lens, 0)
         logits, new_k, new_v = _paged_forward(
-            cfg, params, tokens, k_pages, v_pages, page_table, positions_b,
+            run_cfg, params, tokens, k_pages, v_pages, page_table, positions_b,
             None, attn_impl, write_valid=valid, prefill_kv_lens=kv_lens,
-            ragged_q_lens=q_lens,
+            ragged_q_lens=q_lens, tp=tp,
         )
-        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [R, W]
+        greedy = (
+            tp.argmax(logits) if tp is not None
+            else jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        )  # [R, W]
         # verify resolution (inert elsewhere: decode rows have no drafts and
         # prefill rows' accepted count is ignored by the host)
         accepted = _accepted_prefix(tokens, greedy, q_lens - 1)
         packed = jnp.concatenate([accepted[:, None].astype(jnp.int32), greedy], axis=1)
         return packed, new_k, new_v
 
-    fn = _jit(_step, telemetry, name, donate_argnums=(2, 3))
+    body = _step if tp is None else tp.shard_program(_step, n_args=7)
+    fn = _jit(body, telemetry, name, donate_argnums=(2, 3))
     _paged_program_cache[key] = fn
     return fn

@@ -49,18 +49,10 @@ class InferenceEngine:
         # rebuild the mesh as model=tp_size x data=rest
         tp_req = int(self._config.tensor_parallel.tp_size or 1)
         if tp_req > 1 and self.topology.get_model_parallel_world_size() == 1:
-            import jax as _jax
+            from deepspeed_tpu.parallel.mesh import build_serving_mesh, set_topology
 
-            from deepspeed_tpu.parallel.mesh import MeshConfig, initialize_topology
-
-            n = len(_jax.devices())
-            if n % tp_req != 0:
-                raise ValueError(
-                    f"tp_size={tp_req} does not divide the {n} visible devices"
-                )
-            self.topology = initialize_topology(
-                MeshConfig(model=tp_req, data=n // tp_req)
-            )
+            self.topology = build_serving_mesh(tp_req)
+            set_topology(self.topology)
         self.mesh = self.topology.mesh
         self.dtype = _DTYPES[self._config.dtype]
         self._params = None
@@ -575,9 +567,48 @@ class InferenceEngine:
             journal = RequestJournal(
                 jcfg.dir, segment_bytes=jcfg.segment_bytes, fsync=jcfg.fsync
             )
+        # multi-chip tensor-parallel serving (ISSUE 13): the ragged
+        # programs run under shard_map on a model-axis mesh — weights
+        # column/row-parallel per the AutoTP map, kv pages sharded on the
+        # kv-head axis, host-side scheduling untouched. The serving mesh
+        # is ONE tp group over the first tp_degree devices; replication
+        # across groups is the fleet layer's job (inference/fleet.py).
+        scfg = pcfg.sharded
+        tp_degree = int(scfg.tp_degree or self._config.tensor_parallel.tp_size or 1)
+        tp_ctx = None
+        params = self._params
+        if tp_degree > 1 and not pcfg.ragged and scfg.tp_degree == 0:
+            # FOLLOW mode (sharded.tp_degree=0 defers to tensor_parallel):
+            # tp_size also drives the dense AutoTP forward/generate path,
+            # and tp_size>1 + the bucketed oracle was a valid combination
+            # before sharded serving existed — the bucketed path simply
+            # stays single-chip. (An EXPLICIT sharded.tp_degree>1 with
+            # ragged=False is a contradiction and fails config validation.)
+            log_dist(
+                "paged_kv.ragged=False: bucketed serving stays single-chip "
+                f"(tensor_parallel.tp_size={tp_degree} keeps driving the "
+                "dense generate path; enable ragged or set "
+                "paged_kv.sharded.tp_degree to shard serving)",
+                ranks=[0],
+            )
+            tp_degree = 1
+        if tp_degree > 1:
+            from deepspeed_tpu.inference.tp import TPServing, serving_mesh
+
+            tp_ctx = TPServing(
+                mesh=serving_mesh(tp_degree),
+                quantized_allreduce=scfg.quantized_allreduce,
+                comm_chunks=scfg.comm_chunks,
+            )
+        if scfg.weight_quant_bits == 8:
+            # quantize BEFORE sharding: per-output-channel scales stay
+            # global, so row-parallel partial sums dequantize consistently
+            from deepspeed_tpu.compression.int8 import quantize_params_int8
+
+            params = quantize_params_int8(params)
         server = PagedServer(
             self._ds_config,
-            self._params,
+            params,
             page_size=pcfg.page_size,
             num_pages=pcfg.num_pages,
             max_slots=pcfg.max_slots,
@@ -594,6 +625,7 @@ class InferenceEngine:
             journal=journal,
             tracer=self.tracer,
             metrics=self.metrics,
+            tp=tp_ctx,
         )
         if recovered_states:
             server.recover(recovered_states, next_uid)

@@ -84,11 +84,27 @@ def _copy_page(k_pages, v_pages, src, dst):
 _copy_page_cache: dict = {}
 
 
-def _copy_page_fn(k_pages):
-    key = (k_pages.shape, str(k_pages.dtype))
+def _sharding_key(sharding):
+    """Hashable identity of a NamedSharding for the copier cache (None for
+    the unsharded pools)."""
+    if sharding is None:
+        return None
+    from deepspeed_tpu.utils.jax_compat import mesh_fingerprint
+
+    return (str(sharding.spec), mesh_fingerprint(sharding.mesh))
+
+
+def _copy_page_fn(k_pages, sharding=None):
+    key = (k_pages.shape, str(k_pages.dtype), _sharding_key(sharding))
     fn = _copy_page_cache.get(key)
     if fn is None:
-        fn = jax.jit(_copy_page, donate_argnums=(0, 1))
+        kwargs = {}
+        if sharding is not None:
+            # pin the outputs to the pool's kv-head sharding so the donated
+            # inputs alias shard-for-shard (an unconstrained output could
+            # legally come back resharded, silently breaking the alias)
+            kwargs["out_shardings"] = (sharding, sharding)
+        fn = jax.jit(_copy_page, donate_argnums=(0, 1), **kwargs)
         _copy_page_cache[key] = fn
     return fn
 
@@ -123,12 +139,29 @@ class PagedKVCache(NamedTuple):
 
 
 def init_paged_cache(
-    cfg: TransformerConfig, num_pages: int, page_size: int, dtype=None
+    cfg: TransformerConfig, num_pages: int, page_size: int, dtype=None,
+    sharding=None,
 ) -> PagedKVCache:
+    """Allocate the device page pools. ``sharding`` (tensor-parallel
+    serving) places them kv-head-sharded across the mesh — the page
+    CONTENTS shard on axis 2 while the host-side tables stay replicated,
+    so per-chip KV HBM is ``hbm_bytes() / tp``."""
     if dtype is None:
         dtype = _DTYPES[cfg.dtype]
     shape = (cfg.num_layers, num_pages, cfg.num_kv_heads, page_size, cfg.head_dim)
-    return PagedKVCache(k_pages=jnp.zeros(shape, dtype), v_pages=jnp.zeros(shape, dtype))
+    if sharding is not None:
+        # allocate DIRECTLY sharded: a full-size zeros + device_put would
+        # transiently commit the whole pool to one chip — tp× the
+        # steady-state per-chip footprint, an OOM at bring-up on exactly
+        # the pools sized against aggregate mesh HBM
+        zeros = jax.jit(
+            lambda: (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)),
+            out_shardings=(sharding, sharding),
+        )
+        k, v = zeros()
+    else:
+        k, v = jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+    return PagedKVCache(k_pages=k, v_pages=v)
 
 
 class PagePool:
@@ -157,6 +190,7 @@ class PagePool:
         max_slots: int,
         max_seq_len: Optional[int] = None,
         dtype=None,
+        kv_sharding=None,
     ):
         if page_size < 1 or num_pages < 2:
             raise ValueError("need page_size >= 1 and num_pages >= 2 (page 0 is reserved)")
@@ -164,7 +198,13 @@ class PagePool:
         self.max_slots = int(max_slots)
         self.max_seq_len = int(max_seq_len or cfg.max_seq_len)
         self.max_pages_per_slot = -(-self.max_seq_len // self.page_size)
-        self.cache = init_paged_cache(cfg, num_pages, page_size, dtype=dtype)
+        # tensor-parallel serving: the page contents shard over the kv-head
+        # axis; every host-side structure below (tables, free lists,
+        # refcounts, prefix index) is replicated logic and never changes
+        self.kv_sharding = kv_sharding
+        self.cache = init_paged_cache(
+            cfg, num_pages, page_size, dtype=dtype, sharding=kv_sharding
+        )
         # LIFO free list keeps hot pages hot; page 0 stays out of circulation
         self._free = list(range(num_pages - 1, TRASH_PAGE, -1))
         self._free_slots = list(range(max_slots - 1, -1, -1))
@@ -432,7 +472,7 @@ class PagePool:
             dst = self._acquire_page()
             # one donated in-place page copy per divergence event — never
             # per step, and never a rebuild of the whole cache
-            copy = _copy_page_fn(self.cache.k_pages)
+            copy = _copy_page_fn(self.cache.k_pages, self.kv_sharding)
             new_k, new_v = copy(
                 self.cache.k_pages, self.cache.v_pages,
                 jnp.int32(src), jnp.int32(dst),

@@ -241,8 +241,25 @@ class PagedServer:
         journal: Optional[RequestJournal] = None,
         tracer=None,
         metrics: Optional[MetricsRegistry] = None,
+        tp=None,
     ):
         self.cfg = cfg
+        # tensor-parallel serving (inference/tp.py:TPServing): the SAME
+        # ragged programs run under shard_map on the mesh — weights
+        # column/row-parallel, kv pages sharded on the kv-head axis, page
+        # TABLES (and every other host structure: queues, prefix index,
+        # journal, fleet routing) replicated and untouched. Requires the
+        # ragged path: the bucketed oracle stays single-chip by contract.
+        self.tp = tp
+        if tp is not None:
+            if not ragged:
+                raise ValueError(
+                    "tensor-parallel serving runs the ragged path: enable "
+                    "paged_kv.ragged (the bucketed oracle is single-chip)"
+                )
+            if tp.degree > 1:
+                tp.validate_cfg(cfg)
+            params = tp.shard_params(cfg, params)
         self.params = params
         # unified tracing (profiling/tracer.py): per-step phase spans
         # (admit / pack / dispatch / emit / journal_sync) and per-request
@@ -342,6 +359,7 @@ class PagedServer:
         self.pool = PagePool(
             cfg, num_pages, page_size, max_slots,
             max_seq_len=max_seq, dtype=dtype,
+            kv_sharding=None if tp is None else tp.kv_sharding,
         )
         buckets = sorted(set(int(b) for b in (slot_buckets or _default_buckets(max_slots))))
         if buckets[-1] < max_slots:
@@ -945,7 +963,7 @@ class PagedServer:
         with self.tracer.span("serve.dispatch", rows=len(rows), width=W):
             step_fn = build_ragged_step(
                 self.cfg, R, W, self.pool.page_size, attn_impl=self.attn_impl,
-                telemetry=self.telemetry,
+                telemetry=self.telemetry, tp=self.tp,
             )
             out, new_k, new_v = step_fn(
                 self.params, tokens, self.pool.cache.k_pages, self.pool.cache.v_pages,
@@ -1071,6 +1089,7 @@ class PagedServer:
                 window_fn = build_ragged_multistep(
                     self.cfg, R, 1, H, self.pool.page_size,
                     attn_impl=self.attn_impl, telemetry=self.telemetry,
+                    tp=self.tp,
                 )
                 out, new_k, new_v = window_fn(
                     self.params, tokens, self.pool.cache.k_pages,
@@ -1400,6 +1419,13 @@ class PagedServer:
         s["window_horizon"] = self.ms_horizon if self.ms_enable else 0
         s["dispatches_per_token"] = (
             s["dispatches"] / s["emitted_tokens"] if s["emitted_tokens"] else 0.0
+        )
+        # tensor-parallel serving: the sharding degree this server runs at
+        # (1 = single-chip) and whether the row-parallel all-reduces are
+        # EQuARX-quantized — bench and fleet observability key on these
+        s["tp_degree"] = self.tp.degree if self.tp is not None else 1
+        s["tp_quantized_allreduce"] = (
+            bool(self.tp.quantized_allreduce) if self.tp is not None else False
         )
         s.update(
             live_tokens=self.pool.live_tokens(),

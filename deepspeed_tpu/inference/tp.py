@@ -1,0 +1,317 @@
+"""Tensor-parallel serving context (ISSUE 13): multi-chip sharded ragged
+serving on the mesh.
+
+One :class:`TPServing` object carries everything the serving program
+builders (``inference/decode.py:build_ragged_step`` /
+``build_ragged_multistep``) need to run the SAME ragged step body across a
+``model``-axis mesh under ``shard_map``:
+
+* **weight sharding** — the reference AutoTP / ``SpecLayout`` fsdp×tp
+  pattern specialised to the serving layout (``module_inject/auto_tp.py``
+  sketches the map): column-parallel q/k/v/gate/up (output features =
+  heads shard, so the contiguous slice each chip holds is a contiguous
+  block of heads), row-parallel o/down (input features shard; the partial
+  sums meet in the per-layer all-reduces), vocab-column-parallel LM head
+  (greedy argmax resolves globally in-program), everything else —
+  embeddings, norms, row biases — replicated. Int8-quantized weights
+  (``compression/int8.py``) shard code-and-scale in lockstep.
+* **KV sharding over the kv-head axis** — the paged pools
+  ``[L, NP, NKV, P, D]`` shard axis 2 only. Page *tables* stay host-side
+  numpy and replicated, so ``PagePool`` (free lists, refcounts, prefix
+  index, CoW, journal, fleet router) is completely untouched: only the
+  page CONTENTS shard, and each chip's attention kernel sees the local
+  ``NKV/tp`` heads of every page through the same table.
+* **explicit TP collectives** — the row-parallel projections all-reduce
+  their partial sums per layer. ``comm_chunks`` splits each projection's
+  output features so chunk ``j``'s all-reduce overlaps chunk ``j+1``'s
+  matmul (the static ``overlap`` pass verifies every loop collective has
+  independent MXU work to hide behind). ``quantized_allreduce`` swaps the
+  fp ``psum`` for the EQuARX-style quantized exchange (PAPERS.md,
+  arXiv 2506.17615): int8 all-to-all → local fp32 reduce → int8
+  all-gather — 4x fewer bytes on the wire per phase at a bounded
+  quantization error (two symmetric int8 stages ≈ 1% relative), so the
+  decode-critical-path comm cost drops to ``fp_bytes / 4`` (the
+  ``collectives`` pass accounts it by wire dtype).
+
+The context is **host-constructed and trace-time-consumed**: building one
+allocates nothing on device; ``shard_params`` places the weights once and
+``shard_program`` wraps a step body so the scheduler's dispatch path is
+byte-for-byte the single-chip one (same program names, same ≤2-program
+budget, same one-fetch-per-step contract).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.compression.int8 import QuantizedTensor, qmatmul, slice_out_channels
+from deepspeed_tpu.utils.jax_compat import mesh_fingerprint, shard_map
+
+# serving-layout classification (models/transformer.py param names; the
+# AutoTP walk in module_inject/auto_tp.py generalizes the same policy)
+_COLUMN = frozenset({"wq", "wk", "wv", "w_gate", "w_up", "w_in"})
+_ROW = frozenset({"wo", "w_out"})
+_COLUMN_BIAS = frozenset({"bq", "bk", "bv", "b_in"})
+
+
+def serving_mesh(tp_degree: int, devices=None, axis: str = "model") -> Mesh:
+    """A compact 1-D ``(axis,)`` mesh over the first ``tp_degree`` devices
+    — one tensor-parallel serving group. Replication across groups is the
+    fleet layer's job (``inference/fleet.py``), not this mesh's."""
+    devices = list(devices if devices is not None else jax.devices())
+    if tp_degree < 1:
+        raise ValueError(f"tp_degree must be >= 1, got {tp_degree}")
+    if len(devices) < tp_degree:
+        raise ValueError(
+            f"tp_degree={tp_degree} needs at least that many devices, "
+            f"have {len(devices)}"
+        )
+    return Mesh(np.asarray(devices[:tp_degree]), (axis,))
+
+
+def quantized_all_reduce(x, axis: str, degree: int):
+    """EQuARX-style quantized all-reduce over a shard_map axis: split the
+    last dim into ``degree`` chunks, int8-quantize each chunk with its own
+    scale, **all-to-all** so chip ``i`` holds every chip's chunk ``i``,
+    dequantize + reduce locally in fp32, re-quantize the reduced chunk,
+    and **all-gather** the results. Per phase the payload is int8 — the
+    wire cost of the whole exchange is the fp ring all-reduce's ÷ 4 (the
+    fp32 per-chunk scales ride as side-channel scalars). Falls back to a
+    plain ``psum`` when the last dim does not split ``degree`` ways.
+
+    Error model: two symmetric int8 stages, each elementwise-bounded by
+    ``max|chunk| / 254`` — the serving contract under this knob is
+    allclose, not byte-identical (README "Multi-chip serving")."""
+    if degree == 1:
+        return x
+    shp = x.shape
+    if shp[-1] % degree:
+        return jax.lax.psum(x, axis)
+    xs = jnp.moveaxis(
+        x.reshape(shp[:-1] + (degree, shp[-1] // degree)), -2, 0
+    )  # [tp, ..., F/tp]
+    red = tuple(range(1, xs.ndim))
+    s = jnp.max(jnp.abs(xs.astype(jnp.float32)), axis=red, keepdims=True) / 127.0
+    s = jnp.maximum(s, 1e-30)
+    q = jnp.clip(jnp.round(xs.astype(jnp.float32) / s), -127, 127).astype(jnp.int8)
+    q = jax.lax.all_to_all(q, axis, split_axis=0, concat_axis=0)
+    s = jax.lax.all_to_all(s, axis, split_axis=0, concat_axis=0)
+    y = jnp.sum(q.astype(jnp.float32) * s, axis=0)  # local reduced chunk
+    t = jnp.maximum(jnp.max(jnp.abs(y)) / 127.0, 1e-30)
+    qy = jnp.clip(jnp.round(y / t), -127, 127).astype(jnp.int8)
+    qg = jax.lax.all_gather(qy, axis)  # [tp, ..., F/tp]
+    tg = jax.lax.all_gather(t, axis)  # [tp]
+    yg = qg.astype(jnp.float32) * tg.reshape((degree,) + (1,) * (qg.ndim - 1))
+    return jnp.moveaxis(yg, 0, -2).reshape(shp).astype(x.dtype)
+
+
+class TPServing:
+    """Tensor-parallel context for the paged serving programs.
+
+    Construct from a mesh (``serving_mesh(tp)``) or a live
+    :class:`~deepspeed_tpu.parallel.mesh.Topology`, call
+    :meth:`shard_params` once (places the weights, records the spec tree),
+    and hand the context to ``PagedServer(tp=...)`` — the scheduler passes
+    it through to the program builders. ``degree == 1`` is a valid
+    degenerate context (identity reduces), which the parity tests use to
+    pin the shard_map-wrapped program against the plain oracle."""
+
+    def __init__(
+        self,
+        mesh: Optional[Mesh] = None,
+        axis: str = "model",
+        quantized_allreduce: bool = False,
+        comm_chunks: int = 2,
+        topology=None,
+    ):
+        if mesh is None:
+            if topology is None:
+                from deepspeed_tpu.parallel.mesh import get_topology
+
+                topology = get_topology()
+            mesh = topology.mesh
+        if axis not in mesh.axis_names:
+            raise ValueError(f"mesh has no axis {axis!r}: {mesh.axis_names}")
+        self.mesh = mesh
+        self.axis = axis
+        self.degree = int(dict(zip(mesh.axis_names, mesh.devices.shape))[axis])
+        self.quantized_allreduce = bool(quantized_allreduce)
+        self.comm_chunks = max(1, int(comm_chunks))
+        self.kv_spec = P(None, None, axis, None, None)
+        # the TP context OWNS this sharding; the pool adopts it read-only
+        # at construction (DS-R007 protects the POOL's copy from writers)
+        self.kv_sharding = NamedSharding(mesh, self.kv_spec)  # lint: allow(DS-R007)
+        self.param_specs = None  # set by shard_params
+        self.head_sharded = False  # vocab-column-parallel LM head in play
+        self.quantized_weights = False
+
+    # --- identity (program-cache key component) --------------------------
+    def cache_key(self):
+        return (
+            self.degree,
+            self.axis,
+            self.quantized_allreduce,
+            self.comm_chunks,
+            self.head_sharded,
+            self.quantized_weights,
+            mesh_fingerprint(self.mesh),
+        )
+
+    # --- config & weights ------------------------------------------------
+    def validate_cfg(self, cfg) -> None:
+        if cfg.num_heads % self.degree or cfg.num_kv_heads % self.degree:
+            raise ValueError(
+                f"tensor-parallel serving shards the head axes: num_heads="
+                f"{cfg.num_heads} and num_kv_heads={cfg.num_kv_heads} must "
+                f"both divide by tp={self.degree}"
+            )
+
+    def local_cfg(self, cfg):
+        """The per-shard view of the model config inside shard_map: each
+        chip computes ``NH/tp`` query heads against its ``NKV/tp`` kv-head
+        slice of every page (hidden size, head_dim, and the GQA group size
+        are unchanged)."""
+        if self.degree == 1:
+            return cfg
+        return dataclasses.replace(
+            cfg,
+            num_heads=cfg.num_heads // self.degree,
+            num_kv_heads=cfg.num_kv_heads // self.degree,
+        )
+
+    def _leaf_spec(self, name: str, leaf, cfg) -> Any:
+        ndim = leaf.ndim if isinstance(leaf, QuantizedTensor) else jnp.ndim(leaf)
+        axis = self.axis
+
+        def wspec(kind):
+            stacked = ndim == 3
+            if kind == "col":
+                return P(None, None, axis) if stacked else P(None, axis)
+            if kind == "row":
+                return P(None, axis, None) if stacked else P(axis, None)
+            return P(*([None] * ndim))
+
+        if name in _COLUMN:
+            spec = wspec("col")
+        elif name in _ROW:
+            spec = wspec("row")
+        elif name in _COLUMN_BIAS:
+            spec = P(None, axis) if ndim == 2 else P(axis)
+        elif name == "lm_head" and cfg.vocab_size % self.degree == 0:
+            self.head_sharded = True
+            spec = wspec("col")
+        elif name == "lm_head_bias" and cfg.vocab_size % self.degree == 0:
+            spec = P(axis)
+        else:
+            spec = P(*([None] * ndim))
+        if isinstance(leaf, QuantizedTensor):
+            self.quantized_weights = True
+            # scales follow the OUTPUT channels: a column weight's scales
+            # shard with it; a row weight's scales (full output width,
+            # identical on every chip) replicate
+            scale_entries = list(spec) + [None] * (ndim - len(spec))
+            if ndim >= 2:
+                scale_entries[-2] = None  # the keepdims contraction axis
+            return QuantizedTensor(q=spec, scale=P(*scale_entries))
+        return spec
+
+    def partition_specs(self, params, cfg):
+        """PartitionSpec tree for the serving param layout (matches the
+        params structure leaf-for-leaf, incl. QuantizedTensor pairs)."""
+
+        def walk(name, tree):
+            if isinstance(tree, QuantizedTensor):
+                return self._leaf_spec(name, tree, cfg)
+            if isinstance(tree, dict):
+                return {k: walk(k, v) for k, v in tree.items()}
+            if isinstance(tree, (list, tuple)):
+                return type(tree)(walk(name, v) for v in tree)
+            return self._leaf_spec(name, tree, cfg)
+
+        return walk("", params)
+
+    def shard_params(self, cfg, params):
+        """Validate the config, compute the serving spec tree, and place
+        the weights (one ``device_put``; already-sharded trees reshard).
+        Must run before any program builds — the specs are baked into the
+        shard_map wrapper."""
+        if self.degree > 1:
+            self.validate_cfg(cfg)
+        specs = self.partition_specs(params, cfg)
+        self.param_specs = specs
+        shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(self.mesh, s),
+            specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        return jax.device_put(params, shardings)
+
+    # --- trace-time pieces (used inside the shard_map body) --------------
+    def reduce(self, x):
+        """Sum row-parallel partials across the tp axis (fp psum, or the
+        quantized exchange under ``quantized_allreduce``)."""
+        if self.degree == 1:
+            return x
+        if self.quantized_allreduce:
+            return quantized_all_reduce(x, self.axis, self.degree)
+        return jax.lax.psum(x, self.axis)
+
+    def row_matmul(self, h, w):
+        """Row-parallel projection: ``h_local @ w_local`` partial-summed
+        across the axis. The output features split into ``comm_chunks``
+        and each chunk's partial sum reduces independently — chunk j's
+        collective has chunk j+1's matmul as dependency-free compute, the
+        structure the ``overlap`` pass certifies as hidden."""
+        F = (w.q if isinstance(w, QuantizedTensor) else w).shape[-1]
+        C = self.comm_chunks if self.comm_chunks > 1 and F % self.comm_chunks == 0 else 1
+        if C == 1:
+            return self.reduce(qmatmul(h, w))
+        step = F // C
+        parts = [
+            self.reduce(qmatmul(h, slice_out_channels(w, j * step, step)))
+            for j in range(C)
+        ]
+        return jnp.concatenate(parts, axis=-1)
+
+    def argmax(self, logits):
+        """Greedy argmax over (possibly vocab-sharded) logits, exactly
+        matching the single-chip ``jnp.argmax`` semantics: the FIRST
+        global index achieving the max wins. Shards exchange only their
+        local (max value, global index) pair — no logits gather."""
+        if self.degree == 1 or not self.head_sharded:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        v_local = logits.shape[-1]
+        loc = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        val = jnp.take_along_axis(logits, loc[..., None], axis=-1)[..., 0]
+        idx = loc + jax.lax.axis_index(self.axis).astype(jnp.int32) * v_local
+        vals = jax.lax.all_gather(val, self.axis)  # [tp, ...]
+        idxs = jax.lax.all_gather(idx, self.axis)
+        best = jnp.max(vals, axis=0)
+        cand = jnp.where(vals == best, idxs, jnp.iinfo(jnp.int32).max)
+        return jnp.min(cand, axis=0).astype(jnp.int32)
+
+    def shard_program(self, f, n_args: int):
+        """Wrap a serving step body for the mesh: params take the recorded
+        spec tree, the two page pools shard on the kv-head axis, and every
+        host-built array (tokens, page tables, lengths, q_lens, window
+        masks) replicates. Outputs are the packed host fetch (replicated —
+        every chip resolves the same tokens) plus the sharded pools, so
+        the donated pages alias shard-for-shard."""
+        if self.param_specs is None:
+            raise RuntimeError("TPServing.shard_params must run before building programs")
+        in_specs = (self.param_specs, P(), self.kv_spec, self.kv_spec) + (P(),) * (
+            n_args - 4
+        )
+        return shard_map(
+            f,
+            mesh=self.mesh,
+            in_specs=in_specs,
+            out_specs=(P(), self.kv_spec, self.kv_spec),
+            check_vma=False,
+        )

@@ -223,7 +223,15 @@ class TransformerLM(DSModule):
         qkv/gate/up (shard the output features = heads), row-parallel
         wo/w_out (shard the input features); vocab-parallel embeddings.
         The stacked layer dim [L] stays unsharded (it is scanned).
-        (reference analog: deepspeed/module_inject/auto_tp.py policy walk)"""
+        (reference analog: deepspeed/module_inject/auto_tp.py policy walk)
+
+        NOTE: the paged SERVING engine uses its own specialisation of this
+        map (``inference/tp.py:TPServing.partition_specs``): same
+        column/row split for the projections, but embeddings REPLICATE
+        (the lookup gather stays chip-local under shard_map) and the
+        untied LM head is vocab-COLUMN-parallel with an in-program global
+        argmax instead of the input-vocab-sharded table here — serving
+        resolves greedy tokens, never a cross-entropy."""
         if params_shapes is None:
             return None
 

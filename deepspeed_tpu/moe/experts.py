@@ -55,21 +55,33 @@ def _pointwise_activation(x: jnp.ndarray, activation: str) -> jnp.ndarray:
     raise ValueError(f"unknown pointwise activation {activation!r}")
 
 
-def apply_dense_ffn(params: Dict[str, Any], x: jnp.ndarray, activation: str = "gelu") -> jnp.ndarray:
+def apply_dense_ffn(params: Dict[str, Any], x: jnp.ndarray, activation: str = "gelu",
+                    tp=None) -> jnp.ndarray:
     """[..., H] → [..., H] dense FFN; single source of activation semantics
-    (shared by TransformerLM layers and the PR-MoE residual branch)."""
+    (shared by TransformerLM layers and the PR-MoE residual branch).
+    ``qmatmul`` fuses int8-weight dequantization when the leaves are
+    quantized (``compression/int8.py``). Under tensor-parallel serving
+    (``tp``, a ``inference/tp.py:TPServing`` inside shard_map) the up/gate
+    projections are column-parallel (weights arrive pre-sliced), the down
+    projection is row-parallel through ``tp.row_matmul``'s all-reduce, and
+    the replicated output bias is added once, after the reduce."""
+    from deepspeed_tpu.compression.int8 import qmatmul
+
     dt = x.dtype
     if activation in ("swiglu", "geglu"):
-        gate = x @ params["w_gate"].astype(dt)
-        up = x @ params["w_up"].astype(dt)
+        gate = qmatmul(x, params["w_gate"])
+        up = qmatmul(x, params["w_up"])
         act = jax.nn.silu(gate) if activation == "swiglu" else jax.nn.gelu(gate)
         inner = act * up
     else:
-        inner = x @ params["w_in"].astype(dt)
+        inner = qmatmul(x, params["w_in"])
         if "b_in" in params:
             inner = inner + params["b_in"].astype(dt)
         inner = _pointwise_activation(inner, activation)
-    out = inner @ params["w_out"].astype(dt)
+    out = (
+        tp.row_matmul(inner, params["w_out"]) if tp is not None
+        else qmatmul(inner, params["w_out"])
+    ).astype(dt)
     if "b_out" in params:
         out = out + params["b_out"].astype(dt)
     return out

@@ -38,6 +38,16 @@ Page-table conventions (shared with ``inference/kv_pool.py``): ids < 0 or
 >= num_pages are sentinels for unallocated slots; they are clamped to page 0
 (the pool's reserved trash page) and their scores masked by the length, so
 padded tables are always safe to read.
+
+Tensor-parallel contract (``inference/tp.py``): every entry point here is
+**shard-oblivious**. Under multi-chip serving the ragged step runs these
+inside ``shard_map`` with the page pools sharded on the kv-head axis — the
+kernel then simply sees the LOCAL ``NKV/tp`` kv heads of every page and the
+matching ``NH/tp`` query heads (the GQA group size ``NH/NKV`` is invariant
+under the split, and head blocks are contiguous, so q-head block i attends
+exactly its kv-head block i). Page tables, lengths, and q_lens arrive
+replicated. Nothing in this module reads a mesh axis: the same code is the
+single-chip and the per-shard implementation.
 """
 
 from __future__ import annotations

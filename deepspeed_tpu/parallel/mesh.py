@@ -158,6 +158,28 @@ def build_mesh(
     return Topology(mesh, resolved)
 
 
+def build_serving_mesh(tp_degree: int, devices: Optional[List] = None) -> Topology:
+    """Full-world topology with a ``model=tp_degree`` axis (innermost — TP
+    all-reduces ride the shortest ICI hops), everything else folded into
+    ``data``. ``InferenceEngine.__init__`` re-meshes through this when
+    ``tensor_parallel.tp_size`` asks for a model axis the live topology
+    does not have (it drives the dense AutoTP forward/generate path). The
+    PAGED serving programs instead run on a compact 1-D submesh of the
+    first ``tp_degree`` devices (``inference/tp.py:serving_mesh``) — one
+    TP group; the devices this topology folds into ``data`` are the fleet
+    layer's replica budget."""
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if tp_degree < 1 or n % tp_degree:
+        raise ValueError(
+            f"tp_size={tp_degree} must be >= 1 and divide the {n} visible devices"
+        )
+    return build_mesh(MeshConfig(model=tp_degree, data=n // tp_degree), devices)
+
+
 def initialize_topology(mesh_config: Optional[MeshConfig] = None, devices=None) -> Topology:
     global _TOPOLOGY
     _TOPOLOGY = build_mesh(mesh_config or MeshConfig(), devices)

@@ -14,6 +14,20 @@ from __future__ import annotations
 import jax
 
 
+def mesh_fingerprint(mesh) -> tuple:
+    """Hashable identity of a Mesh — axis names, shape, and the flat
+    device ids. The ONE definition shared by every cache that must not
+    serve an executable (or an out_shardings contract) built for one mesh
+    to arrays living on another: the paged-program cache key
+    (``inference/tp.py:TPServing.cache_key``) and the pool's CoW copier
+    cache (``inference/kv_pool.py``)."""
+    return (
+        tuple(mesh.axis_names),
+        tuple(int(s) for s in mesh.devices.shape),
+        tuple(d.id for d in mesh.devices.flat),
+    )
+
+
 def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, axis_names=None, **kwargs):
     """``jax.shard_map`` with the modern signature on any supported jax.
 

@@ -285,6 +285,66 @@ def test_r007_quiet_inside_pool_and_on_reads():
     """)
 
 
+def test_r005_tp_ragged_step_host_transfer_flagged():
+    """ISSUE 13 red test: the tensor-parallel scheduler path — ragged
+    steps, fused windows, and their settle methods — is inside the
+    one-fetch-per-dispatch budget too. A host transfer smuggled into a
+    ``_tp_step`` / ``_ragged_step`` / ``_ragged_window`` /
+    ``_settle_window_rows`` costs a synchronous RTT on EVERY chip of the
+    serving mesh, so DS-R005 must see those methods."""
+    rules = _rules("""
+        import numpy as np, jax
+        class ShardedPagedServer:
+            def _ragged_step(self):
+                toks = np.asarray(self.pending)      # fetch per dispatch
+            def _tp_step(self):
+                lens = jax.device_get(self.lengths)  # ditto, tp spelling
+            def _ragged_window(self):
+                n = self.emitted.item()
+            def _settle_window_rows(self, rows, out):
+                out = np.asarray(out)
+    """)
+    assert rules.count("DS-R005") == 4
+
+
+def test_r005_tp_settle_pragma_budget_still_honored():
+    """The sanctioned single packed fetch of a window stays pragma-able —
+    the rule polices UNBUDGETED transfers, not the contract fetch."""
+    findings = lint_source(textwrap.dedent("""
+        import numpy as np
+        class ShardedPagedServer:
+            def _ragged_step(self):
+                pass
+            def _settle_ragged_rows(self, rows, out):
+                out = np.asarray(out)  # lint: allow(DS-R005)
+                extra = np.asarray(self.lengths)
+    """), path="deepspeed_tpu/foo.py")
+    r005 = [f for f in findings if f.rule == "DS-R005"]
+    assert len(r005) == 1  # only the unbudgeted second fetch
+
+
+def test_r007_kv_sharding_write_flagged():
+    """ISSUE 13 red test: the pool's kv-head sharding is part of its
+    device-layout invariants — rebinding it outside the pool (e.g. a TP
+    helper 'fixing up' placement mid-serve) silently de-aliases every
+    donated page buffer. DS-R007 must flag the write on any receiver."""
+    rules = _rules("""
+        class TPScheduler:
+            def rebalance(self, pool, sharding):
+                pool.kv_sharding = sharding
+                self.server.pool.kv_sharding = None
+    """)
+    assert rules.count("DS-R007") == 2
+
+
+def test_r007_kv_sharding_quiet_inside_pool():
+    assert "DS-R007" not in _rules("""
+        class PagePool:
+            def __init__(self, kv_sharding=None):
+                self.kv_sharding = kv_sharding
+    """)
+
+
 def test_r007_pragma_suppresses_and_is_error_severity():
     findings = lint_source(textwrap.dedent("""
         def restore(pool, table):

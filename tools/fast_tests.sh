@@ -70,6 +70,16 @@
 # prefill/decode role split, elasticity resize policy + journal-catch-up
 # join, fleet-adds-0-programs compile gate. The real kill -9
 # restart-and-adopt case is `-m slow`.
+# +multi-chip TP serving 2026-08-04 (test_tp_serving.py + extended
+# test_source_lint.py; the analysis gate test_passes.py::
+# test_green_tp_serving rides the lint.sh analysis suite below):
+# tensor-parallel sharded ragged serving on the virtual CPU mesh —
+# byte-identical greedy streams at tp∈{1,2,4} vs the single-chip oracle
+# across admission/preemption/prefix-attach/spec-K/multi-step windows,
+# ≤2-compiled-programs + 1-dispatch-per-step + retrace guards ON the
+# mesh, int8 weight roundtrip ≤ max|w_ch|/254 + logits-allclose bound,
+# EQuARX quantized all-reduce allclose + wire-bytes = fp/4 accounting,
+# DS-R005/DS-R007 TP-path lint extensions.
 cd "$(dirname "$0")/.." || exit 1
 sh tools/lint.sh || exit 1
 exec python -m pytest -q \
@@ -95,6 +105,7 @@ exec python -m pytest -q \
   tests/unit/inference/test_ragged_serving.py \
   tests/unit/inference/test_multistep_serving.py \
   tests/unit/inference/test_spec_decode.py \
+  tests/unit/inference/test_tp_serving.py \
   tests/unit/inference/test_traffic.py \
   tests/unit/inference/test_fleet.py \
   tests/unit/ops/test_paged_attention.py \
