@@ -276,6 +276,81 @@ def _trace_fields(engine, name, timed_window=None, overhead_reps=8):
         return {"trace_error": f"{type(e).__name__}: {e}"[:160]}
 
 
+def _multistep_fields(engine_factory, batch, tokens_per_step, horizon=None):
+    """Multi-step TRAINING window A/B (ISSUE 14), same-seed: two fresh
+    engines from ``engine_factory(multi_step_on, horizon)`` — identical
+    config seed, identical repeated batch, both driven through
+    ``train_batch(data_iter)`` so the measured loops pay the same data/h2d
+    structure — one with ``compile.multi_step`` armed, one without.
+
+    Records the windowed tokens/s (``multistep_value``), the A/B ratio
+    (``multistep_vs_singlestep``; on the tunneled TPU the ~2 ms dispatch
+    RTT amortizes to 1/N, on this CPU box the enqueue overhead does),
+    ``dispatches_per_opt_step`` from the engine's window stats (telemetry-
+    derived: the tentpole's 1/N target), and the tracer phase deltas the
+    windows exist to crush — data_fetch / h2d / dispatch / loss_fetch mean
+    ms as ``[single_step, windowed]`` pairs (the windowed loss_fetch is
+    the deferred ``train.loss_drain``). Runs AFTER the headline window on
+    its own engines; the headline record's compile counters are untouched."""
+    import itertools
+
+    try:
+        H = int(horizon or (4 if TINY else 8))
+
+        def run(ms_on):
+            engine = engine_factory(ms_on, H)
+            it = itertools.repeat(batch)
+            # warmup to a window boundary: 1 sequential init step (compiles
+            # the single-step program) + one full window (compiles the
+            # window program); the single-step arm just compiles + settles
+            for _ in range(1 + (H if ms_on else 1)):
+                engine.train_batch(data_iter=it)
+            if ms_on:
+                engine.flush_loss_drain()
+            _drain(engine)
+            engine.tracer.clear()
+            steps = 2 * H
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                engine.train_batch(data_iter=it)
+            if ms_on:
+                engine.flush_loss_drain()
+            _drain(engine)
+            dt = time.perf_counter() - t0
+            return engine, steps, dt, engine.tracer.phase_summary()
+
+        seq_engine, steps, seq_dt, seq_ph = run(False)
+        seq_tps = steps * tokens_per_step / seq_dt if seq_dt > 0 else 0.0
+        win_engine, steps, win_dt, win_ph = run(True)
+        win_tps = steps * tokens_per_step / win_dt if win_dt > 0 else 0.0
+        ws = win_engine.window_stats()
+
+        def mean_ms(ph, key):
+            v = ph.get(key)
+            return round(v["mean_ms"], 3) if v else 0.0
+
+        return {
+            "multistep_horizon": H,
+            "multistep_value": round(win_tps, 1),
+            "multistep_vs_singlestep": round(win_tps / seq_tps, 4) if seq_tps else 0.0,
+            "dispatches_per_opt_step": round(ws["dispatches_per_opt_step"], 4),
+            "train_window_steps": ws["window_steps"],
+            "train_window_break_reasons": {
+                k: v for k, v in ws["window_break_reasons"].items() if v
+            },
+            "multistep_phase_ms": {
+                k: [mean_ms(seq_ph, k), mean_ms(win_ph, k)]
+                for k in (
+                    "train.data_fetch", "train.h2d", "train.dispatch",
+                    "train.loss_fetch", "train.loss_drain",
+                )
+            },
+        }
+    except Exception as e:
+        traceback.print_exc()
+        return {"multistep_error": f"{type(e).__name__}: {e}"[:160]}
+
+
 def _ckpt_fields(engine):
     """Fault-tolerance telemetry for a training record (ISSUE 9), measured
     AFTER the timed window on a scratch dir:
@@ -385,6 +460,22 @@ def bench_gpt2_zero1():
             timed_window=lambda n: _timed_steps(engine, batch, warmup=0, steps=n)[0],
         )
     )
+
+    def _ms_engine(ms_on, horizon):
+        return _train_engine(
+            TransformerLM(mcfg),
+            {
+                "train_micro_batch_size_per_gpu": micro,
+                "optimizer": {"type": "adam", "params": {"lr": 3e-4, "weight_decay": 0.01}},
+                "bf16": {"enabled": True},
+                "zero_optimization": {"stage": 1},
+                "gradient_clipping": 1.0,
+                "steps_per_print": 10_000,
+                "compile": {"multi_step": {"enable": ms_on, "horizon": horizon}},
+            },
+        )
+
+    rec.update(_multistep_fields(_ms_engine, batch, micro * n_chips * seq))
     return rec
 
 
@@ -439,6 +530,27 @@ def bench_llama_zero3():
     rec.update(_compile_fields(engine))
     rec.update(_analysis_fields(engine))
     rec.update(_ckpt_fields(engine))
+
+    def _ms_engine(ms_on, horizon):
+        return _train_engine(
+            TransformerLM(mcfg),
+            {
+                "train_micro_batch_size_per_gpu": micro,
+                "optimizer": {"type": "adam", "params": {"lr": 3e-4}},
+                "bf16": {"enabled": True},
+                "zero_optimization": {"stage": 3},
+                "gradient_clipping": 1.0,
+                "steps_per_print": 10_000,
+                "compile": {"multi_step": {"enable": ms_on, "horizon": horizon}},
+            },
+        )
+
+    rec.update(
+        _multistep_fields(
+            _ms_engine, batch, micro * seq,
+            horizon=4 if TINY else 8,
+        )
+    )
     return rec
 
 

@@ -44,7 +44,9 @@ Rules (each one traces back to a real incident in PERF.md / PR history):
 * **DS-R009 raw-clock-in-step-loop** — a raw ``time.time()`` /
   ``time.perf_counter()`` / ``time.monotonic()`` call, or a ``device_sync``
   (full async-dispatch drain), inside a step-loop method of an
-  ``*Engine`` / ``*Server`` / ``*Scheduler`` class: ad-hoc timing forks a
+  ``*Engine`` / ``*Server`` / ``*Scheduler`` / ``*Loader`` class (the
+  multi-step window family and the prefetching input pipeline run on the
+  same critical path): ad-hoc timing forks a
   second, invisible timeline next to the unified tracer (ISSUE 10), and a
   stray ``device_sync`` serializes host and device on every step (the
   ``SynchronizedWallClockTimer.stop(sync=True)`` default this PR removed).
@@ -158,15 +160,20 @@ _HOT_FN = re.compile(
 _NP_CASTS = ("np.asarray", "np.array", "numpy.asarray", "numpy.array", "onp.asarray")
 
 # DS-R009 scope: step-loop methods of engine/server/scheduler classes —
-# the code that runs between (or around) every hot dispatch. The tracer /
+# the code that runs between (or around) every hot dispatch — plus the
+# input-pipeline Loader classes (ISSUE 14: a prefetching loader's __next__
+# runs once per microbatch on the same critical path, and the multi-step
+# window family — formation, per-step commit, deferred loss drain, lr
+# pre-evaluation — runs between every window dispatch). The tracer /
 # timer / sync modules OWN the clocks and are exempt by path.
 _R009_EXEMPT_PATH = re.compile(r"(utils/timer\.py|utils/sync\.py|profiling/)")
-_R009_CLASS = re.compile(r"(Engine|Server|Scheduler)$")
+_R009_CLASS = re.compile(r"(Engine|Server|Scheduler|Loader)$")
 _R009_FN = re.compile(
     r"^_?(forward|backward|step|train_batch|fused_train_batch|take_model_step"
     r"|take_offload_step|generate|(plain_)?(decode|prefill|verify|spec|ragged)"
     r"_(step|round)|admit|emit|run|serve|settle_spec_row|reserve_for_growth"
-    r"|finish_step_bookkeeping)$"
+    r"|finish_step_bookkeeping|try_train_window|commit_window_step"
+    r"|drain_pending|window_lrs|window_loader|__next__|pull|fill)$"
 )
 # call names that read a raw clock or drain the dispatch queue
 _R009_BASES = {"perf_counter", "monotonic", "device_sync", "perf_counter_ns", "monotonic_ns"}

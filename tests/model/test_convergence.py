@@ -26,7 +26,13 @@ import sys
 
 import pytest
 
-pytestmark = pytest.mark.nightly
+# nightly AND slow: the ini's `addopts = -m "not nightly and not slow"` is
+# OVERRIDDEN by any explicit -m on the command line, and the tier-1 command
+# runs `-m 'not slow'` — which used to pull these ~100-step subprocess
+# convergence legs into tier-1 and stall it past its timeout (the standing
+# PR-9/-10/-11 note in CHANGES.md). Double-marking keeps them out of every
+# non-nightly selection; run them with `pytest -m nightly tests/model/`.
+pytestmark = [pytest.mark.nightly, pytest.mark.slow]
 
 _HERE = os.path.abspath(__file__)
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(_HERE)))

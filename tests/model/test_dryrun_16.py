@@ -11,7 +11,9 @@ import sys
 
 import pytest
 
-pytestmark = pytest.mark.nightly
+# nightly AND slow: an explicit `-m 'not slow'` (the tier-1 command)
+# overrides the ini addopts' nightly exclusion — see test_convergence.py
+pytestmark = [pytest.mark.nightly, pytest.mark.slow]
 
 
 def test_dryrun_multichip_16():

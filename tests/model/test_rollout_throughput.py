@@ -20,7 +20,9 @@ from deepspeed_tpu.inference.decode import generate as kv_generate
 from deepspeed_tpu.inference.generation import greedy_generate
 from deepspeed_tpu.models import TransformerLM, llama_config
 
-pytestmark = pytest.mark.nightly
+# nightly AND slow: an explicit `-m 'not slow'` (the tier-1 command)
+# overrides the ini addopts' nightly exclusion — see test_convergence.py
+pytestmark = [pytest.mark.nightly, pytest.mark.slow]
 
 CTX, NEW = 256, 12
 

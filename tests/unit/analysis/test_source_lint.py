@@ -488,6 +488,57 @@ def test_r009_quiet_outside_scope():
     ]
 
 
+def test_r009_window_and_prefetch_methods_in_scope():
+    """ISSUE 14 extension: the multi-step window family (formation,
+    per-step commit, deferred drain, lr pre-evaluation) and the
+    input-pipeline Loader methods run on the same step critical path —
+    a raw clock there is the same fork of the timeline. Red."""
+    findings = _rules("""
+        import time
+        class FooEngine:
+            def _try_train_window(self, it):
+                t0 = time.perf_counter()
+            def _commit_window_step(self):
+                return time.time()
+            def _drain_pending(self, keep=0):
+                time.monotonic()
+            def _window_lrs(self, n):
+                return time.perf_counter()
+        class PrefetchingLoader:
+            def __next__(self):
+                t = time.perf_counter()
+            def _pull(self):
+                return time.time()
+            def fill(self, n=None):
+                device_sync()
+    """)
+    assert findings.count("DS-R009") == 7
+
+
+def test_r009_loader_quiet_outside_hot_methods():
+    """A Loader's non-pipeline methods (state_dict etc.) may time freely,
+    and the REAL dataloader module lints clean under the extended scope."""
+    assert "DS-R009" not in _rules("""
+        import time
+        class PrefetchingLoader:
+            def state_dict(self):
+                return {"t": time.time()}  # not a hot-path method
+        class DataLoader:
+            def __len__(self):
+                return int(time.perf_counter())
+    """)
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))))
+    path = os.path.join(root, "deepspeed_tpu", "runtime", "dataloader.py")
+    with open(path) as fh:
+        src = fh.read()
+    assert [
+        f.rule for f in lint_source(src, path="deepspeed_tpu/runtime/dataloader.py")
+    ] == []
+
+
 def test_r009_pragma_suppresses_and_is_error_severity():
     src = """
         import time

@@ -1,0 +1,553 @@
+"""Multi-step fused training windows (``compile.multi_step``; ISSUE 14).
+
+The acceptance contract: with windows armed, ``train_batch(data_iter)`` is
+BIT-identical to the unwindowed run — same per-step losses, same master
+param tree, same loss-scale trajectory (including a forced fp16
+overflow-skip INSIDE a window), same lr schedule — across
+zero ∈ {1, 3} × {bf16, fp16-with-forced-overflow} × gas ∈ {1, 2} and
+horizons {2, 4}; the host gap amortizes (steady-state
+``dispatches_per_opt_step`` ≤ 1/N via compile telemetry, one compiled
+window program per armed horizon, no retrace after the first wave);
+windows break — counted in ``window_break_reasons`` — on checkpoint
+intervals, monitor flushes, the flops-profiler step, and dataloader
+exhaustion, and never straddle a checkpoint boundary (the
+``train.mid_window`` chaos kill resumes bit-identically from the last
+committed checkpoint); and the prefetching input pipeline preserves the
+PR-8 exact-resume data-cursor semantics.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+import deepspeed_tpu.parallel.mesh as mesh_mod
+from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader, PrefetchingLoader
+from deepspeed_tpu.utils import chaos
+from tests.unit.simple_model import SimpleModel, master_snapshot
+
+STEPS = 6
+
+
+def _cfg(multi_step, gas=1, horizon=2, precision="bf16", stage=1, prefetch=True, **over):
+    base = {
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": stage},
+        "compile": {
+            # the window scans the fused grad-accum body at gas>1, so the
+            # sequential comparison arm runs the same program family
+            "fuse_grad_accum": gas > 1,
+            "multi_step": {"enable": multi_step, "horizon": horizon, "prefetch": prefetch},
+        },
+        "gradient_clipping": 1.0,
+        "scheduler": {
+            "type": "WarmupLR",
+            "params": {"warmup_min_lr": 0.0, "warmup_max_lr": 1e-2, "warmup_num_steps": 10},
+        },
+    }
+    if precision == "bf16":
+        base["bf16"] = {"enabled": True}
+    elif precision == "fp16":
+        base["fp16"] = {"enabled": True, "initial_scale_power": 4, "hysteresis": 1}
+    base.update(over)
+    return base
+
+
+def _engine(multi_step, **kw):
+    mesh_mod.reset_topology()
+    engine, *_ = ds.initialize(model=SimpleModel(), config=_cfg(multi_step, **kw))
+    return engine
+
+
+def _batches(gas, steps, seed=0, bad_step=None):
+    """Deterministic microbatch stream; ``bad_step`` (an int or a set of
+    step indices) injects an inf into that step's first microbatch (the
+    fp16 forced-overflow probe)."""
+    bad = (
+        set() if bad_step is None
+        else ({bad_step} if isinstance(bad_step, int) else set(bad_step))
+    )
+    rs = np.random.RandomState(seed)
+    out = []
+    for s in range(steps):
+        for g in range(gas):
+            x = rs.randn(8, 16).astype(np.float32)
+            y = rs.randn(8, 16).astype(np.float32)
+            if s in bad and g == 0:
+                x = x.copy()
+                x[0, 0] = np.inf
+            out.append((x, y))
+    return out
+
+
+def _drive(engine, data, steps):
+    it = iter(list(data))
+    return [float(engine.train_batch(data_iter=it)) for _ in range(steps)]
+
+
+def _assert_same_master(a, b):
+    wa, wb = master_snapshot(a), master_snapshot(b)
+    assert set(wa) == set(wb)
+    for k in wa:
+        np.testing.assert_array_equal(wa[k], wb[k])
+
+
+# ---------------------------------------------------------------------------
+# bit-identity matrix
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("gas", [1, 2])
+@pytest.mark.parametrize("precision", ["bf16", "fp16"])
+@pytest.mark.parametrize("stage", [1, 3])
+def test_window_vs_sequential_bit_identical(stage, precision, gas, eight_devices):
+    """The core acceptance sweep: windowed losses, master trees, loss-scale
+    trajectory, skip counters, and the lr schedule all bit-match N
+    sequential ``train_batch`` calls. fp16 runs force an overflow INSIDE a
+    window (step 3 of 6: mid-window at horizon 2 after the sequential init
+    step) so the in-program skip/rescale + lr-cursor freeze is exercised."""
+    bad = 3 if precision == "fp16" else None
+    data = _batches(gas, STEPS, bad_step=bad)
+    ref = _engine(False, gas=gas, precision=precision, stage=stage)
+    ref_losses = _drive(ref, data, STEPS)
+    win = _engine(True, gas=gas, precision=precision, stage=stage, horizon=2)
+    win_losses = _drive(win, data, STEPS)
+    assert win_losses == ref_losses
+    assert win.window_stats()["window_steps"] >= 2, win.window_stats()
+    _assert_same_master(ref, win)
+    assert win.skipped_steps == ref.skipped_steps
+    assert win.loss_scale == ref.loss_scale
+    assert float(win.optimizer.param_groups[0]["lr"]) == float(
+        ref.optimizer.param_groups[0]["lr"]
+    )
+    if precision == "fp16":
+        assert win.skipped_steps == 1  # the forced overflow actually fired
+
+
+def test_window_horizon4_bit_identical(eight_devices):
+    """Horizon 4 (the second acceptance horizon), fp16 with the overflow on
+    the LAST step of a window — the lr cursor freeze at the window edge."""
+    steps = 9
+    data = _batches(1, steps, bad_step=4)  # step idx 4 = last step of window 1..4
+    ref = _engine(False, precision="fp16")
+    ref_losses = _drive(ref, data, steps)
+    win = _engine(True, precision="fp16", horizon=4)
+    win_losses = _drive(win, data, steps)
+    assert win_losses == ref_losses
+    assert win.skipped_steps == ref.skipped_steps == 1
+    assert win.loss_scale == ref.loss_scale
+    _assert_same_master(ref, win)
+    ws = win.window_stats()
+    assert ws["window_steps"] == 2 and ws["windowed_opt_steps"] == 8, ws
+
+
+# ---------------------------------------------------------------------------
+# horizon edge cases + break accounting
+# ---------------------------------------------------------------------------
+def test_tail_and_exhaustion_fall_back_single_step(eight_devices):
+    """steps % N != 0: the tail that cannot fill a window runs sequentially
+    (no new program, counted under the 'data' break) and still bit-matches;
+    a fully exhausted iterator raises StopIteration like the sequential
+    path always did."""
+    steps = 6  # 1 sequential init + window(2) + window(2) + 1 tail
+    data = _batches(1, steps)
+    ref = _engine(False)
+    ref_losses = _drive(ref, data, steps)
+    win = _engine(True, horizon=2)
+    it = iter(list(data))
+    win_losses = [float(win.train_batch(data_iter=it)) for _ in range(steps)]
+    assert win_losses == ref_losses
+    ws = win.window_stats()
+    assert ws["window_steps"] == 2
+    assert ws["window_break_reasons"]["data"] >= 1, ws
+    # only the armed horizon's program compiled — the tail reused the
+    # single-step fused program, no tail-sized window variant exists
+    window_programs = [
+        n for n in win.compile_stats() if n.startswith("fused_window_step")
+    ]
+    assert window_programs == ["fused_window_step_n2"]
+    with pytest.raises(StopIteration):
+        win.train_batch(data_iter=it)
+
+
+def test_checkpoint_interval_breaks_window(eight_devices, tmp_path):
+    """A checkpoint-interval boundary inside the horizon breaks the window
+    BEFORE dispatch: every auto-save lands exactly on its boundary with the
+    counters caught up (windows never straddle), and the broken steps are
+    counted under 'checkpoint'."""
+    steps = 9
+    data = _batches(1, steps)
+    win = _engine(
+        True, horizon=3,
+        checkpoint={"interval_steps": 4, "save_dir": str(tmp_path)},
+    )
+    saved_at = []
+    orig = win.save_checkpoint
+
+    def spy(*a, **k):
+        saved_at.append(win.global_steps)
+        assert not win._window_stash, "auto-save fired mid-window"
+        return orig(*a, **k)
+
+    win.save_checkpoint = spy
+    losses = _drive(win, data, steps)
+    ref = _engine(False)
+    assert losses == _drive(ref, data, steps)
+    assert saved_at == [4, 8], saved_at
+    ws = win.window_stats()
+    # step 1 is the sequential init; windows cover 2-4 and 5-7 (each ends
+    # exactly ON or before a boundary); step 8 sits 1 step from the
+    # boundary at 8 — less than the horizon — so it breaks on 'checkpoint'
+    # and runs sequentially; step 9 has only 1 step of data left ('data')
+    assert ws["window_break_reasons"]["checkpoint"] == 1, ws
+    assert ws["window_break_reasons"]["data"] == 1, ws
+    assert ws["window_steps"] == 2, ws
+
+
+def test_monitor_flush_breaks_window(eight_devices, tmp_path):
+    """An armed monitor flushes every interval_steps — the window must end
+    there (the flush device_gets the step's loss), counted under 'monitor'."""
+    win = _engine(
+        True, horizon=4,
+        monitor={"enabled": True, "interval_steps": 2,
+                 "jsonl": {"enabled": True, "output_path": str(tmp_path)}},
+    )
+    data = _batches(1, 5)
+    _drive(win, data, 5)
+    ws = win.window_stats()
+    assert ws["window_break_reasons"]["monitor"] >= 1, ws
+    assert ws["window_steps"] == 0  # horizon 4 never fits inside interval 2
+
+
+# ---------------------------------------------------------------------------
+# prefetching input pipeline
+# ---------------------------------------------------------------------------
+def test_prefetcher_cursor_exact_resume_roundtrip():
+    """PrefetchingLoader reports the cursor of the first UNDELIVERED batch
+    (not the source's pulled-ahead one), and load_state_dict resumes the
+    exact sequence — over a RE-ITERABLE source; a bare-iterator source
+    refuses to 'restore' (a running generator cannot rewind, and silently
+    continuing would skip the staged batches)."""
+    data = [np.full((4,), i, np.float32) for i in range(12)]
+    loader = DeepSpeedDataLoader(data, batch_size=2)
+    pf = PrefetchingLoader(iter(loader), depth=3, state_source=loader)
+    first = next(pf)
+    second = next(pf)
+    assert float(first[0, 0]) == 0.0 and float(second[0, 0]) == 2.0
+    # 2 delivered; up to 3 more staged — the source cursor is ahead, the
+    # wrapper's is not
+    assert loader.state_dict()["cursor"] > 2
+    sd = pf.state_dict()
+    assert sd == {"epoch": 0, "cursor": 2}
+    # resume via a re-iterable source: the sequence continues at 2
+    loader_b = DeepSpeedDataLoader(data, batch_size=2)
+    pf_b = PrefetchingLoader(loader_b, depth=3)
+    pf_b.load_state_dict(sd)
+    np.testing.assert_array_equal(next(pf_b), next(pf))
+    np.testing.assert_array_equal(next(pf_b), next(pf))
+    # a bare-iterator source cannot rewind — restoring must refuse, not
+    # silently skip the staged batches
+    loader_c = DeepSpeedDataLoader(data, batch_size=2)
+    pf_c = PrefetchingLoader(iter(loader_c), depth=3, state_source=loader_c)
+    with pytest.raises(ValueError, match="re-iterable"):
+        pf_c.load_state_dict(sd)
+
+
+def test_prefetcher_place_fn_and_exhaustion():
+    """place_fn applies at PULL time (the staged device_put), fill() reports
+    data availability without consuming, and exhaustion is latched."""
+    placed = []
+
+    def place(b):
+        placed.append(len(placed))
+        return jax.numpy.asarray(b)
+
+    pf = PrefetchingLoader(iter([np.ones(2)] * 3), place_fn=place, depth=2)
+    assert pf.fill(3) == 3  # only 3 exist
+    assert len(placed) == 3  # all were placed at pull time, ahead of use
+    out = [next(pf) for _ in range(3)]
+    assert all(isinstance(o, jax.Array) for o in out)
+    with pytest.raises(StopIteration):
+        next(pf)
+    assert pf.fill(1) == 0
+
+
+def test_engine_checkpoint_cursor_ignores_prefetched_batches(eight_devices, tmp_path):
+    """A checkpoint cut while the engine's prefetcher has staged batches
+    ahead must carry the cursor of the first UNDELIVERED batch — the PR-8
+    mid-epoch exact-resume contract under the double-buffered pipeline."""
+    data = [(np.random.RandomState(i).randn(16).astype(np.float32),
+             np.zeros(16, np.float32)) for i in range(80)]
+
+    def build():
+        mesh_mod.reset_topology()
+        return ds.initialize(
+            model=SimpleModel(),
+            config=_cfg(True, horizon=2),
+            training_data=data,
+        )
+
+    a, _, loader_a, _ = build()
+    it = iter(loader_a)
+    for _ in range(3):  # 1 sequential init step + one window of 2
+        a.train_batch(data_iter=it)
+    # the window's top-up pulled ahead: source cursor > 3 delivered
+    assert a._active_prefetcher is not None
+    assert loader_a.state_dict()["cursor"] > 3
+    a.save_checkpoint(str(tmp_path))
+    b, _, loader_b, _ = build()
+    b.init_params(data[0])
+    b.load_checkpoint(str(tmp_path))
+    assert loader_b.state_dict() == {"epoch": 0, "cursor": 3}
+    # resumed run consumes batch 3 next — identical to an unpaused one
+    # (batch_size is micro×dp = 8, so batch 3 starts at sample 24)
+    nxt = next(iter(loader_b))
+    np.testing.assert_array_equal(np.asarray(nxt[0])[0], data[24][0])
+
+
+# ---------------------------------------------------------------------------
+# dispatch amortization + retrace guards (compile telemetry)
+# ---------------------------------------------------------------------------
+def test_steady_state_dispatches_per_opt_step(eight_devices):
+    """THE perf gate: after the init step, every window is ONE dispatch of
+    the fused program covering H steps — steady-state dispatches/opt-step
+    ≤ 1/H, measured through compile telemetry, with telemetry and the
+    engine's window_stats reconciling exactly."""
+    H = 4
+    win = _engine(True, horizon=H)
+    steps = 1 + 3 * H  # sequential init + exactly 3 full windows
+    data = _batches(1, steps)
+    _drive(win, data, steps)
+    stats = win.compile_stats()
+    wrec = stats["fused_window_step_n4"]
+    ws = win.window_stats()
+    assert wrec["dispatches"] == ws["window_steps"] == 3
+    # steady-state bound: ignore the single init step, the windowed
+    # segment is exactly 1/H
+    windowed = ws["windowed_opt_steps"]
+    assert windowed == 3 * H
+    assert wrec["dispatches"] / windowed == 1.0 / H
+    # whole-run form (init step included) stays under the sequential cost
+    assert ws["dispatches_per_opt_step"] <= (1.0 / H) + (1.0 / ws["opt_steps"])
+    assert ws["dispatches"] == wrec["dispatches"] + stats["fused_step"]["dispatches"]
+
+
+def test_three_wave_retrace_guard(eight_devices):
+    """Three waves of windows with varying data: everything compiles in
+    wave 1 and NOTHING retraces after — one compiled window program per
+    armed horizon, ≤1 compile per program."""
+    H = 2
+    win = _engine(True, horizon=H)
+    compiles_after = []
+    for wave in range(3):
+        data = _batches(1, 1 + 2 * H, seed=wave)
+        _drive(win, data, 1 + 2 * H)
+        compiles_after.append(
+            sum(r["compiles"] for r in win.compile_stats().values())
+        )
+    assert compiles_after[1] == compiles_after[0], compiles_after
+    assert compiles_after[2] == compiles_after[0], compiles_after
+    for name, rec in win.compile_stats().items():
+        assert rec["compiles"] <= 1, (name, rec)
+    assert (
+        sum(1 for n in win.compile_stats() if n.startswith("fused_window_step")) == 1
+    )
+
+
+def test_drained_losses_match_returned(eight_devices):
+    """The deferred drain delivers the SAME values a per-step device_get
+    would have — only later. Every windowed step shows up exactly once, in
+    step order, after flush."""
+    H = 2
+    win = _engine(True, horizon=H)
+    steps = 1 + 2 * H
+    data = _batches(1, steps)
+    losses = _drive(win, data, steps)
+    assert win.window_stats()["pending_loss_drains"] >= 1  # deferral is real
+    win.flush_loss_drain()
+    drained = win.drained_losses()
+    assert [d["step"] for d in drained] == [2, 3, 4, 5]
+    for d in drained:
+        assert d["loss"] == losses[d["step"] - 1]
+        assert d["overflow"] is False
+
+
+# ---------------------------------------------------------------------------
+# chaos: kill mid-window, resume bit-identically (satellite 2)
+# ---------------------------------------------------------------------------
+def test_mid_window_chaos_kill_resumes_bit_identical(eight_devices, tmp_path):
+    """``train.mid_window`` fires between the window dispatch and the loss
+    drain: the donated state is already N steps ahead but NOTHING was
+    committed. A fresh engine auto-resumes from the last committed
+    checkpoint (window-aligned by the formation clamp) and the continued
+    run is bit-identical — losses AND master tree — to an uninterrupted
+    one. fp16 + interval autosave: the hardest variant."""
+    steps = 9
+    data = _batches(1, steps, seed=7)
+    over = {
+        "checkpoint": {"interval_steps": 2, "save_dir": str(tmp_path)},
+        "scheduler": None,
+    }
+
+    def build():
+        mesh_mod.reset_topology()
+        cfg = _cfg(True, horizon=2, precision="fp16")
+        cfg["checkpoint"] = over["checkpoint"]
+        engine, *_ = ds.initialize(model=SimpleModel(), config=cfg)
+        return engine
+
+    ref = build()
+    # reference consumes the autosave dir too: rebuild it clean after
+    ref_losses = _drive(ref, data, steps)
+    ref_master = master_snapshot(ref)
+    import shutil
+
+    shutil.rmtree(str(tmp_path))
+    tmp_path.mkdir()
+
+    e = build()
+    it = iter(list(data))
+    committed = []
+    chaos.install(chaos.ChaosSchedule([chaos.ChaosRule("train.mid_window", hit=2)]))
+    try:
+        for _ in range(steps):
+            committed.append(float(e.train_batch(data_iter=it)))
+        raise AssertionError("chaos never fired")
+    except chaos.ChaosKilled:
+        pass
+    finally:
+        chaos.uninstall()
+    assert committed == ref_losses[: len(committed)]
+
+    e2 = build()
+    e2.init_params(data[0])
+    path, _ = e2.load_checkpoint(str(tmp_path), auto_resume=True)
+    assert path is not None
+    resumed_from = e2.global_steps
+    assert resumed_from % 2 == 0  # a committed interval boundary
+    assert resumed_from >= len(committed) - 1  # at most the in-flight window lost
+    it2 = iter(list(data[resumed_from:]))
+    resumed = [
+        float(e2.train_batch(data_iter=it2)) for _ in range(steps - resumed_from)
+    ]
+    assert resumed == ref_losses[resumed_from:]
+    e2_master = master_snapshot(e2)
+    for k in ref_master:
+        np.testing.assert_array_equal(ref_master[k], e2_master[k])
+
+
+# ---------------------------------------------------------------------------
+# config + protocol red tests
+# ---------------------------------------------------------------------------
+def test_config_red_horizon_too_small():
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+    with pytest.raises(Exception, match="horizon"):
+        DeepSpeedConfig({
+            "train_batch_size": 8,
+            "compile": {"multi_step": {"enable": True, "horizon": 1}},
+        })
+
+
+def test_config_red_gas_without_fuse(eight_devices):
+    with pytest.raises(ValueError, match="fuse_grad_accum"):
+        cfg = _cfg(True, gas=2, horizon=2)
+        cfg["compile"]["fuse_grad_accum"] = False
+        mesh_mod.reset_topology()
+        ds.initialize(model=SimpleModel(), config=cfg)
+
+
+def test_config_red_incompatible_features(eight_devices):
+    for key, val, pat in [
+        ("curriculum_learning", {"enabled": True, "min_difficulty": 8,
+                                 "max_difficulty": 16, "schedule_type": "fixed_linear",
+                                 "schedule_config": {"total_curriculum_step": 10,
+                                                     "difficulty_step": 8}},
+         "curriculum"),
+        ("progressive_layer_drop", {"enabled": True}, "progressive_layer_drop"),
+    ]:
+        cfg = _cfg(True, horizon=2)
+        cfg[key] = val
+        mesh_mod.reset_topology()
+        with pytest.raises(ValueError, match=pat):
+            ds.initialize(model=SimpleModel(), config=cfg)
+
+
+def test_mid_window_protocol_guards(eight_devices, tmp_path):
+    """With computed-but-uncommitted steps stashed, every state-touching
+    surface refuses loudly: save/load checkpoint, eval(), forward(), batch
+    resize, and train_batch(batch=...)."""
+    win = _engine(True, horizon=3)
+    data = _batches(1, 1 + 3)
+    it = iter(list(data))
+    win.train_batch(data_iter=it)  # sequential init
+    win.train_batch(data_iter=it)  # window dispatch: 2 steps stashed
+    assert len(win._window_stash) == 2
+    with pytest.raises(RuntimeError, match="mid-window"):
+        win.save_checkpoint(str(tmp_path))
+    with pytest.raises(RuntimeError, match="mid-window"):
+        win.load_checkpoint(str(tmp_path))
+    with pytest.raises(RuntimeError, match="mid-flight"):
+        win.eval()
+    with pytest.raises(RuntimeError, match="mid-flight"):
+        win.forward(data[0])
+    with pytest.raises(RuntimeError, match="mid-window"):
+        win.set_train_batch_size(16)
+    with pytest.raises(RuntimeError, match="mid-flight"):
+        win.train_batch(batch=data[0])
+    # draining the stash restores every surface
+    win.train_batch(data_iter=it)
+    win.train_batch(data_iter=it)
+    assert not win._window_stash
+    win.save_checkpoint(str(tmp_path))
+
+
+def test_all_overflow_first_window_keeps_lr_exact(eight_devices):
+    """The fp16 scale-settling phase: every step up to and including the
+    whole first window overflows, so the lr scheduler NEVER steps before
+    the second window forms. The lr pre-evaluation's snapshot→replay→
+    restore must not leak the replayed warmup value into the live param
+    groups (_LRSchedulerBase.load_state_dict only re-applies lr for a
+    stepped scheduler) — the run must stay bit-identical to sequential."""
+    steps = 7
+    bad = {0, 1, 2}  # the sequential init step AND both steps of window 1
+    data = _batches(1, steps, bad_step=bad)
+    ref = _engine(False, precision="fp16")
+    ref_losses = _drive(ref, data, steps)
+    win = _engine(True, precision="fp16", horizon=2)
+    win_losses = _drive(win, data, steps)
+    assert win_losses == ref_losses
+    assert win.skipped_steps == ref.skipped_steps == 3
+    assert float(win.optimizer.param_groups[0]["lr"]) == float(
+        ref.optimizer.param_groups[0]["lr"]
+    )
+    _assert_same_master(ref, win)
+    assert win.window_stats()["window_steps"] >= 2  # windows really formed
+
+
+def test_resize_cannot_silently_disarm_windows(eight_devices):
+    """A live gas resize must honor the same multi_step contract the
+    constructor validates: raising gas past 1 without fuse_grad_accum
+    would rebuild with windows silently disarmed — it raises instead."""
+    win = _engine(True, horizon=2)  # gas=1, fuse_grad_accum off
+    _drive(win, _batches(1, 3), 3)
+    with pytest.raises(ValueError, match="fuse_grad_accum"):
+        win.set_train_batch_size(16)  # gas 1 -> 2
+    assert win.window_stats()["multi_step_enabled"] is True  # untouched
+
+
+def test_window_stats_block_and_observability(eight_devices):
+    """window_stats rides engine.observability() as the train_window
+    source, and the tracer timeline carries train.window spans."""
+    win = _engine(True, horizon=2)
+    data = _batches(1, 5)
+    _drive(win, data, 5)
+    rep = win.observability(analysis=False)
+    assert rep["train_window"]["window_steps"] >= 2
+    assert rep["train_window"]["multi_step_enabled"] is True
+    phases = win.tracer.phase_summary()
+    assert "train.window" in phases
+    assert phases["train.window"]["count"] == rep["train_window"]["window_steps"]
+    assert "train.loss_drain" in phases

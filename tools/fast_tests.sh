@@ -80,11 +80,25 @@
 # mesh, int8 weight roundtrip ≤ max|w_ch|/254 + logits-allclose bound,
 # EQuARX quantized all-reduce allclose + wire-bytes = fp/4 accounting,
 # DS-R005/DS-R007 TP-path lint extensions.
+# +multi-step TRAINING windows 2026-08-04 (test_multistep_training.py +
+# test_passes.py::test_green_multistep_training_program on the lint.sh
+# analysis suite + DS-R009 window/Loader lint extension): N-optimizer-
+# steps-per-dispatch fused windows — window vs sequential BIT-identical
+# losses/master-trees/loss-scale across zero{1,3} × {bf16, fp16-forced-
+# overflow} × gas{1,2} × horizon{2,4}, checkpoint/monitor/data/profiler
+# break accounting (windows never straddle a checkpoint interval),
+# train.mid_window chaos kill → auto_resume bit-identical, prefetching-
+# loader cursor exact-resume roundtrips, steady-state dispatches/opt-step
+# ≤ 1/N via compile telemetry + 3-wave retrace guard, deferred-loss-drain
+# value identity, mid-window protocol guards, window-program green sweep
+# (full state tuple donated THROUGH the lax.scan carry, 0 in-program host
+# transfers).
 cd "$(dirname "$0")/.." || exit 1
 sh tools/lint.sh || exit 1
 exec python -m pytest -q \
   tests/unit/runtime/test_engine.py \
   tests/unit/runtime/test_fused_grad_accum.py \
+  tests/unit/runtime/test_multistep_training.py \
   tests/unit/runtime/test_compile_telemetry.py \
   tests/unit/runtime/test_config.py \
   tests/unit/runtime/test_lr_schedules.py \
