@@ -753,6 +753,71 @@ def overlap_pass(art: ProgramArtifact, config: Optional[Dict[str, Any]] = None) 
                 details=e,
             )
         )
+
+    # host-stream accounting mode (ZeRO-Infinity offload, ISSUE 16): the
+    # engine declares its H2D/D2H stream schedule — per-bucket transfers,
+    # each naming the compute program it hides behind — anchored to one
+    # analyzed program. Transfers with no hiding program (pipeline knob
+    # off), or naming a program NOT in the declared compute set (a schedule
+    # cannot smuggle transfers behind phantom work), count as EXPOSED
+    # stream bytes; the CI gate pins exposed_stream_bytes == 0.
+    stream = cfg.get("offload_stream")
+    if stream and art.name == stream.get("anchor"):
+        known = set(stream.get("compute_programs", ()))
+        transfers = list(stream.get("transfers", ()))
+        s_h2d = s_d2h = s_exposed = 0
+        stream_exposed: List[Dict[str, Any]] = []
+        for t in transfers:
+            b = int(t.get("bytes", 0))
+            if t.get("direction") == "h2d":
+                s_h2d += b
+            else:
+                s_d2h += b
+            hide = t.get("hide_behind")
+            if not hide or hide not in known:
+                s_exposed += b
+                stream_exposed.append(dict(t))
+        res.summary.update(
+            {
+                "stream_transfers": len(transfers),
+                "stream_h2d_bytes": s_h2d,
+                "stream_d2h_bytes": s_d2h,
+                "exposed_stream_bytes": s_exposed,
+                "stream_exposed": stream_exposed,
+                "stream_verified": s_exposed == 0,
+            }
+        )
+        for t in stream_exposed:
+            hide = t.get("hide_behind")
+            why = (
+                f"declares hiding program {hide!r} which is not in the "
+                "declared compute set"
+                if hide
+                else "declares no hiding compute (pipeline knob off?)"
+            )
+            res.violations.append(
+                Violation(
+                    "overlap",
+                    art.name,
+                    f"offload {t.get('direction')} stream transfer "
+                    f"{t.get('name')} ({t.get('bytes')} bytes) "
+                    f"{why}: the stream is exposed on the step critical path",
+                    severity=severity,
+                    details=dict(t),
+                )
+            )
+        budget = cfg.get("stream_budget_bytes")
+        if budget is not None and budget >= 0 and (s_h2d + s_d2h) > budget:
+            res.violations.append(
+                Violation(
+                    "overlap",
+                    art.name,
+                    f"declared offload stream traffic {s_h2d + s_d2h} bytes "
+                    f"exceeds analysis.stream_budget_bytes={budget}",
+                    severity="error",
+                    details={"h2d_bytes": s_h2d, "d2h_bytes": s_d2h},
+                )
+            )
     return res
 
 

@@ -91,6 +91,11 @@ def run_program_passes(
     overlap_ok = True
     overlap_ran = False
     hidden_bytes = exposed_bytes = 0
+    # host-stream accounting (ZeRO-Infinity offload): only the anchor
+    # program carries stream summaries, so "ran" flips on first sighting
+    stream_ok = True
+    stream_ran = False
+    stream_h2d = stream_d2h = stream_exposed = 0
     coll_ops: Dict[str, Dict[str, int]] = {}
     coll_bytes = coll_count = 0
 
@@ -145,6 +150,13 @@ def run_program_passes(
                     overlap_ok = False
                 hidden_bytes += res.summary.get("hidden_bytes", 0)
                 exposed_bytes += res.summary.get("exposed_bytes", 0)
+                if "stream_transfers" in res.summary:
+                    stream_ran = True
+                    stream_h2d += res.summary.get("stream_h2d_bytes", 0)
+                    stream_d2h += res.summary.get("stream_d2h_bytes", 0)
+                    stream_exposed += res.summary.get("exposed_stream_bytes", 0)
+                    if not res.summary.get("stream_verified", False):
+                        stream_ok = False
             if pname == "collectives":
                 for op, rec in res.summary.get("ops", {}).items():
                     agg = coll_ops.setdefault(op, {"count": 0, "bytes": 0})
@@ -166,6 +178,12 @@ def run_program_passes(
         "overlap_verified": overlap_ok if overlap_ran else None,
         "hidden_collective_bytes": hidden_bytes,
         "exposed_collective_bytes": exposed_bytes,
+        # tri-state again: None unless a declared offload stream schedule
+        # reached its anchor program this report
+        "stream_verified": stream_ok if stream_ran else None,
+        "stream_h2d_bytes": stream_h2d,
+        "stream_d2h_bytes": stream_d2h,
+        "exposed_stream_bytes": stream_exposed,
         "collective_count": coll_count,
         "collective_bytes": coll_bytes,
         "collectives": coll_ops,
@@ -178,28 +196,39 @@ def engine_analysis_report(
     analysis_config,
     programs: Optional[Sequence[str]] = None,
     passes: Optional[Sequence[str]] = None,
+    extra_config: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """The one implementation behind BOTH engines' ``analysis_report()``:
     apply the config's pass narrowing + thresholds to
     ``run_program_passes``. ``analysis_config`` is an ``AnalysisConfig``
-    (training or inference — same model)."""
+    (training or inference — same model). ``extra_config`` carries
+    engine-declared pass inputs the static config cannot know — e.g. the
+    ZeRO-Infinity ``offload_stream`` schedule for the overlap pass."""
     if passes is None and analysis_config.passes:
         passes = list(analysis_config.passes)
+    config = {
+        "min_donation_bytes": analysis_config.min_donation_bytes,
+        "collective_budget_bytes": analysis_config.collective_budget_bytes,
+        "stream_budget_bytes": getattr(analysis_config, "stream_budget_bytes", None),
+    }
+    if extra_config:
+        config.update(extra_config)
     return run_program_passes(
         telemetry,
         programs=programs,
         passes=passes,
-        config={
-            "min_donation_bytes": analysis_config.min_donation_bytes,
-            "collective_budget_bytes": analysis_config.collective_budget_bytes,
-        },
+        config=config,
     )
 
 
-def verify_program(telemetry, analysis_config, name: str, logger=None) -> None:
+def verify_program(
+    telemetry, analysis_config, name: str, logger=None, extra_config=None
+) -> None:
     """analysis.verify hook body shared by both engines: run the passes on
     one freshly compiled program, then warn or raise per the config."""
-    report = engine_analysis_report(telemetry, analysis_config, programs=[name])
+    report = engine_analysis_report(
+        telemetry, analysis_config, programs=[name], extra_config=extra_config
+    )
     raise_or_warn(report, analysis_config.verify, logger=logger)
 
 

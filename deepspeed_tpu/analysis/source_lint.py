@@ -52,7 +52,15 @@ Rules (each one traces back to a real incident in PERF.md / PR history):
   ``SynchronizedWallClockTimer.stop(sync=True)`` default this PR removed).
   Route timing through the engine's tracer/timers (``profiling/tracer.py``,
   ``utils/timer.py`` — both files are out of scope for the rule, as is
-  ``utils/sync.py``); deliberate exceptions carry a pragma.
+  ``utils/sync.py``); deliberate exceptions carry a pragma. The host-offload
+  ``*Streamer`` stream/writer family (ISSUE 16) is in scope twice over:
+  its bucket methods are step-loop code (raw clocks flagged like any
+  engine method), AND raw host copies (``device_put`` / ``device_get`` /
+  ``copy_to_host_async`` / ``block_until_ready``) outside the sanctioned
+  stream helpers (``h2d_bucket`` / ``d2h_bucket`` / ``_land`` /
+  ``materialize_writes`` / ``drain_writes``) are flagged — an
+  unaccounted copy never shows up in the stream-overlap analysis, so the
+  "fully hidden behind compute" gate would silently lie.
 * **DS-R010 jax-import-in-host-only-module** — an ``import jax`` /
   ``from jax ...`` (incl. ``jax.numpy``) anywhere in a module declared
   pure-host: the fleet router (``inference/fleet.py``) and the tracer
@@ -95,7 +103,7 @@ RULES = {
     "DS-R006": "blocking collective on parameters inside a scanned layer body",
     "DS-R007": "PagePool internals mutated outside the pool's own methods",
     "DS-R008": "non-atomic persistence write (open 'w' without temp+rename) in a checkpoint/journal/bench path",
-    "DS-R009": "raw clock / device_sync call inside an engine/scheduler step-loop method (route through the tracer/timer)",
+    "DS-R009": "raw clock / device_sync / unsanctioned host copy inside an engine/scheduler/streamer step-loop method (route through the tracer/timer or the stream helpers)",
     "DS-R010": "jax import in a host-only module (the fleet router / tracer must stay pure host code)",
 }
 _WARN_ONLY = {"DS-R003", "DS-R004"}
@@ -107,7 +115,7 @@ _R010_HOST_ONLY = re.compile(r"(inference/fleet\.py|profiling/tracer\.py)$")
 
 # DS-R008 scope: files (or enclosing functions) that persist state other
 # code will later trust — checkpoint layouts, journals, bench records.
-_PERSIST_PATH = re.compile(r"(checkpoint|journal|bench)", re.IGNORECASE)
+_PERSIST_PATH = re.compile(r"(checkpoint|journal|bench|host_offload)", re.IGNORECASE)
 _PERSIST_FN = re.compile(r"(checkpoint|journal|known_good|latest|marker)", re.IGNORECASE)
 # the sanctioned atomic pattern: writes into a temp/staging sibling that a
 # rename later commits
@@ -167,17 +175,32 @@ _NP_CASTS = ("np.asarray", "np.array", "numpy.asarray", "numpy.array", "onp.asar
 # pre-evaluation — runs between every window dispatch). The tracer /
 # timer / sync modules OWN the clocks and are exempt by path.
 _R009_EXEMPT_PATH = re.compile(r"(utils/timer\.py|utils/sync\.py|profiling/)")
-_R009_CLASS = re.compile(r"(Engine|Server|Scheduler|Loader)$")
+_R009_CLASS = re.compile(r"(Engine|Server|Scheduler|Loader|Streamer)$")
 _R009_FN = re.compile(
     r"^_?(forward|backward|step|train_batch|fused_train_batch|take_model_step"
-    r"|take_offload_step|generate|(plain_)?(decode|prefill|verify|spec|ragged)"
+    r"|take_offload_step|take_streamed_offload_step|generate"
+    r"|(plain_)?(decode|prefill|verify|spec|ragged)"
     r"_(step|round)|admit|emit|run|serve|settle_spec_row|reserve_for_growth"
     r"|finish_step_bookkeeping|try_train_window|commit_window_step"
-    r"|drain_pending|window_lrs|window_loader|__next__|pull|fill)$"
+    r"|drain_pending|window_lrs|window_loader|__next__|pull|fill"
+    r"|h2d_bucket|d2h_bucket|gather_device_state|scatter_device_state"
+    r"|materialize_writes|drain_writes|discard_staged|take_staged|land)$"
 )
 # call names that read a raw clock or drain the dispatch queue
 _R009_BASES = {"perf_counter", "monotonic", "device_sync", "perf_counter_ns", "monotonic_ns"}
 _R009_EXACT = {"time.time", "time.clock", "_sync"}
+
+# DS-R009 stream-copy discipline (ISSUE 16): inside a host-offload
+# ``*Streamer`` class, every raw host copy must live in one of the
+# sanctioned stream helpers — those are the only call sites the stream
+# accounting (``stream_schedule`` → the overlap pass) knows about, and
+# the only ones the step pipelines (double-buffered H2D, async D2H
+# writer) order correctly against donation. ``__init__`` (seeding host
+# buffers before any stepping) and ``set_master_leaves`` (checkpoint
+# restore surgery) are sanctioned entry points too.
+_STREAMER_CLASS = re.compile(r"Streamer$")
+_STREAM_HELPER_FN = re.compile(r"^(__init__|_?set_master|_?(h2d|d2h|land|materialize|drain))")
+_STREAM_COPY_BASES = {"device_put", "device_get", "copy_to_host_async", "block_until_ready"}
 
 _CACHEY = re.compile(
     r"(cache|page|pool|buffer|^kv$|^k$|^v$|^k_|^v_|_kv$|kv_)", re.IGNORECASE
@@ -469,6 +492,32 @@ def lint_source(src: str, path: str = "<string>") -> List[LintFinding]:
                             f"raw {fname}() in {where}: ad-hoc clocks fork the "
                             "timeline (and device_sync serializes the step) — "
                             "route through the engine tracer/timer",
+                        )
+
+        # stream-copy discipline: raw host copies in a *Streamer class
+        # outside the sanctioned stream helpers bypass the stream
+        # accounting the overlap gate audits
+        for cls in ast.walk(tree):
+            if not (isinstance(cls, ast.ClassDef) and _STREAMER_CLASS.search(cls.name)):
+                continue
+            for fn in cls.body:
+                if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                if _STREAM_HELPER_FN.match(fn.name):
+                    continue  # the sanctioned copy helpers own the raw calls
+                for n in ast.walk(fn):
+                    if not isinstance(n, ast.Call):
+                        continue
+                    base = _dotted(n.func).rsplit(".", 1)[-1]
+                    if base in _STREAM_COPY_BASES:
+                        add(
+                            n.lineno,
+                            "DS-R009",
+                            f"raw {base} in {cls.name}.{fn.name}: host copies "
+                            "outside the sanctioned stream helpers (h2d_bucket/"
+                            "d2h_bucket/materialize_writes/drain_writes) never "
+                            "enter the stream accounting, so the overlap gate "
+                            "can't see them",
                         )
 
     # ---- DS-R006: blocking param collectives in scan bodies -----------

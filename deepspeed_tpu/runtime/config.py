@@ -208,6 +208,10 @@ class AnalysisConfig(DeepSpeedConfigModel):
     passes: List[str] = Field(default_factory=list)
     min_donation_bytes: int = 0
     collective_budget_bytes: Optional[int] = None
+    # ZeRO-Infinity stream gate: budget for the DECLARED per-step offload
+    # H2D+D2H stream bytes (overlap pass stream-accounting mode). None = no
+    # budget; any declared traffic above it is an error-severity violation.
+    stream_budget_bytes: Optional[int] = None
 
     @field_validator("verify")
     @classmethod

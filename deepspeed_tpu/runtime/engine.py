@@ -50,7 +50,7 @@ from jax.sharding import NamedSharding, PartitionSpec
 from deepspeed_tpu import comm as dist
 from deepspeed_tpu.accelerator import get_accelerator
 from deepspeed_tpu.ops.adagrad.cpu_adagrad import DeepSpeedCPUAdagrad
-from deepspeed_tpu.ops.adam.fused_adam import Adam, AdamW, FusedAdam
+from deepspeed_tpu.ops.adam.fused_adam import Adam, AdamState, AdamW, FusedAdam
 from deepspeed_tpu.ops.lamb.fused_lamb import FusedLamb
 from deepspeed_tpu.ops.optimizer import DSOptimizer
 from deepspeed_tpu.ops.sgd import SGD
@@ -404,6 +404,9 @@ class DeepSpeedEngine:
         self._pending_model_parameters = model_parameters
 
         self._host_offload = None
+        self._streamed_offload = False  # ZeRO-Infinity streamed master/moments
+        self._jit_offload_stats = None
+        self._jit_offload_bucket = []  # one donated update program per bucket
         self._param_stream = None  # ZeRO-Infinity layer-streamed param offload
         self._stream_scale = 1.0
         self.partitioner: Optional[ZeroPartitioner] = None
@@ -824,31 +827,60 @@ class DeepSpeedEngine:
             self._master = self._params
 
         if self._offload_enabled():
-            # ZeRO-Offload/Infinity: fp32 master + moments leave the chip —
-            # host DRAM (device=cpu) or local SSD (device=nvme) via the
-            # native AVX Adam + aio swapper (runtime/zero/offload_states.py)
-            from deepspeed_tpu.runtime.zero.offload_states import HostOffloadAdam
-
-            opt_cfg = self._config.optimizer_config
+            offcfg = self._config.zero_config.offload_optimizer
             self._validate_host_adam("offload_optimizer")
-            params_cfg = dict(opt_cfg.params) if opt_cfg is not None else {}
-            self._host_offload = HostOffloadAdam(
-                master,
-                self.compute_dtype,
-                self._config.zero_config.offload_optimizer,
-                aio_param_dict=self._config._param_dict,
-                betas=tuple(params_cfg.get("betas", (0.9, 0.999))),
-                eps=params_cfg.get("eps", 1e-8),
-                weight_decay=params_cfg.get("weight_decay", 0.0),
-                adamw_mode=params_cfg.get("adam_w_mode", True),
-            )
-            self._host_offload.set_param_dtypes(
-                [l.dtype for l in jax.tree_util.tree_leaves(self._params)]
-            )
-            # free the device-side master: the host copy is authoritative now
-            self._master = None
-            self._opt_state = None
-            self._opt_shardings = None
+            if offcfg.pipeline and str(offcfg.device.value) == "cpu":
+                # ZeRO-Infinity STREAMED path (runtime/zero/host_offload.py):
+                # fp32 master + moments live in host buffers and stream
+                # device-ward per bucket through the depth-2 pipeline; the
+                # per-bucket donated device program applies the exact fused
+                # update math, so the device Adam — not a host reimplementation
+                # — remains the single source of step arithmetic.
+                from deepspeed_tpu.runtime.zero.host_offload import HostOffloadStreamer
+
+                self._host_offload = HostOffloadStreamer(
+                    master,
+                    offcfg,
+                    mixed_precision=self.mixed_precision,
+                    clock=self.tracer.clock,
+                )
+                self._streamed_offload = True
+                # the window program (compile.multi_step) still needs the
+                # device-side opt shardings to rebuild/donate gathered state
+                opt_specs = self.optimizer.state_specs(self._master_specs)
+                self._opt_shardings = jax.tree_util.tree_map(
+                    lambda s: NamedSharding(self.mesh, s),
+                    opt_specs,
+                    is_leaf=lambda x: isinstance(x, PartitionSpec),
+                )
+                # free the device-side master: the host copy is authoritative
+                self._master = None
+                self._opt_state = None
+            else:
+                # legacy ZeRO-Offload: fp32 master + moments leave the chip —
+                # host DRAM (device=cpu) or local SSD (device=nvme) via the
+                # native AVX Adam + aio swapper (runtime/zero/offload_states.py)
+                from deepspeed_tpu.runtime.zero.offload_states import HostOffloadAdam
+
+                opt_cfg = self._config.optimizer_config
+                params_cfg = dict(opt_cfg.params) if opt_cfg is not None else {}
+                self._host_offload = HostOffloadAdam(
+                    master,
+                    self.compute_dtype,
+                    offcfg,
+                    aio_param_dict=self._config._param_dict,
+                    betas=tuple(params_cfg.get("betas", (0.9, 0.999))),
+                    eps=params_cfg.get("eps", 1e-8),
+                    weight_decay=params_cfg.get("weight_decay", 0.0),
+                    adamw_mode=params_cfg.get("adam_w_mode", True),
+                )
+                self._host_offload.set_param_dtypes(
+                    [l.dtype for l in jax.tree_util.tree_leaves(self._params)]
+                )
+                # free the device-side master: the host copy is authoritative now
+                self._master = None
+                self._opt_state = None
+                self._opt_shardings = None
         else:
             self._host_offload = None
             opt_specs = self.optimizer.state_specs(self._master_specs)
@@ -1459,10 +1491,23 @@ class DeepSpeedEngine:
         # device array post-hoc would dispatch tiny gather programs the
         # compile-telemetry gates forbid.
         mscfg = self._config.compile_config.multi_step
+        # streamed host offload composes with windows: the window program
+        # runs the on-device fused step body over GATHERED master/moments
+        # (see _try_train_window), so the offload-disabled fused flags don't
+        # gate it — the same construction conditions do, minus offload.
+        _streamed_window_ok = self._streamed_offload and not qgz and (
+            gas == 1
+            or (
+                bool(self._config.compile_config.fuse_grad_accum)
+                and self.random_ltd_scheduler is None
+            )
+        )
         self._window_armed = bool(
             mscfg.enable
-            and self._host_offload is None
-            and (self._fused_step_enabled if gas == 1 else self._fused_accum_enabled)
+            and (
+                (self._fused_step_enabled if gas == 1 else self._fused_accum_enabled)
+                or _streamed_window_ok
+            )
         )
         self._window_horizon = int(mscfg.horizon) if self._window_armed else 0
         if self._window_armed:
@@ -1540,9 +1585,100 @@ class DeepSpeedEngine:
         else:
             self._jit_fused_window_step = None
 
+        if self._streamed_offload:
+            # ZeRO-Infinity streamed path: the update math stays ON DEVICE —
+            # offload_stats mirrors step_fn's preamble op-for-op (unscale to
+            # fp32 FIRST, then overflow/norm/clip on the unscaled grads, the
+            # bit-identity contract with the on-device step), then one
+            # donated per-bucket program applies optimizer.apply to the
+            # streamed-in master/moments slice; see _take_streamed_offload_step
+            def offload_stats(grad_acc, scale):
+                inv = 1.0 / (scale * gas)
+                grads32 = jax.tree_util.tree_map(
+                    lambda g: g.astype(jnp.float32) * inv, grad_acc
+                )
+                overflow = (
+                    has_inf_or_nan(grads32) if fp16 else jnp.zeros((), jnp.bool_)
+                )
+                sq = sum(jnp.sum(jnp.square(g)) for g in jax.tree_util.tree_leaves(grads32))
+                grad_norm = jnp.sqrt(sq)
+                if clip > 0:
+                    coef = jnp.minimum(1.0, clip / (grad_norm + 1e-6))
+                else:
+                    coef = jnp.float32(1.0)
+                return grad_norm, coef, overflow
+
+            self._jit_offload_stats = self._telemetry.instrument("offload_stats", offload_stats)  # lint: allow(DS-R004) — read-only: the bucket programs re-read (and zero) grad_acc after
+            self._jit_zero_grads = self._telemetry.instrument(
+                "zero_grads",
+                lambda t: jax.tree_util.tree_map(jnp.zeros_like, t),
+                donate_argnums=(0,),
+            )
+
+            ho = self._host_offload
+            optimizer = self.optimizer
+            master_sh = jax.tree_util.tree_leaves(self._master_shardings)
+            param_sh = jax.tree_util.tree_leaves(self._param_shardings)
+            grad_sh = jax.tree_util.tree_leaves(self._grad_shardings)
+            self._jit_offload_bucket = []
+            for bi in range(ho.num_buckets):
+                idx = ho.bucket_indices(bi)
+                b_master_sh = tuple(master_sh[i] for i in idx)
+                b_param_sh = tuple(param_sh[i] for i in idx)
+                b_grad_sh = tuple(grad_sh[i] for i in idx)
+                if mixed:
+
+                    def bucket_update(masters, ms, vs, accs, params_old, scale, coef, step, lr):
+                        inv = 1.0 / (scale * gas)
+                        grads32 = tuple(a.astype(jnp.float32) * inv for a in accs)
+                        if clip > 0:
+                            grads32 = tuple(g * coef for g in grads32)
+                        state = AdamState(step=step, exp_avg=tuple(ms), exp_avg_sq=tuple(vs))
+                        new_master, new_state = optimizer.apply(
+                            grads32, state, tuple(masters), jnp.float32(lr)
+                        )
+                        new_params = tuple(
+                            m.astype(p.dtype) for m, p in zip(new_master, params_old)
+                        )
+                        zeroed = tuple(jnp.zeros_like(a) for a in accs)
+                        return new_master, new_state.exp_avg, new_state.exp_avg_sq, new_params, zeroed
+
+                    jit_fn = self._telemetry.instrument(
+                        f"offload_bucket_update_b{bi}",
+                        bucket_update,
+                        donate_argnums=(0, 1, 2, 3, 4),
+                        out_shardings=(b_master_sh, b_master_sh, b_master_sh, b_param_sh, b_grad_sh),
+                        **step_jit_extra,
+                    )
+                else:
+                    # fp32: the bucket's params ARE the master (one buffer)
+
+                    def bucket_update(masters, ms, vs, accs, scale, coef, step, lr):
+                        inv = 1.0 / (scale * gas)
+                        grads32 = tuple(a.astype(jnp.float32) * inv for a in accs)
+                        if clip > 0:
+                            grads32 = tuple(g * coef for g in grads32)
+                        state = AdamState(step=step, exp_avg=tuple(ms), exp_avg_sq=tuple(vs))
+                        new_master, new_state = optimizer.apply(
+                            grads32, state, tuple(masters), jnp.float32(lr)
+                        )
+                        zeroed = tuple(jnp.zeros_like(a) for a in accs)
+                        return new_master, new_state.exp_avg, new_state.exp_avg_sq, zeroed
+
+                    jit_fn = self._telemetry.instrument(
+                        f"offload_bucket_update_b{bi}",
+                        bucket_update,
+                        donate_argnums=(0, 1, 2, 3),
+                        out_shardings=(b_master_sh, b_master_sh, b_master_sh, b_grad_sh),
+                        **step_jit_extra,
+                    )
+                self._jit_offload_bucket.append(jit_fn)
+            self._jit_step = None
+            return
+
         if self._host_offload is not None:
-            # offload path: the fused device step is replaced by (tiny jitted
-            # grad stats) + host AVX Adam; see _take_model_step
+            # legacy offload path: the fused device step is replaced by (tiny
+            # jitted grad stats) + host AVX Adam; see _take_model_step
             def grad_stats(grad_acc, scale):
                 inv = 1.0 / (scale * gas)
                 sq = sum(jnp.sum(jnp.square(g)) for g in jax.tree_util.tree_leaves(grad_acc))
@@ -1973,12 +2109,24 @@ class DeepSpeedEngine:
                 "compile.multi_step is incompatible with "
                 "zero_quantized_gradients (the qgZ grad path is unfused)"
             )
-        if self._offload_requested(zcfg.offload_optimizer) or self._offload_requested(
-            zcfg.offload_param
-        ):
+        off = zcfg.offload_optimizer
+        streamed_opt_offload = (
+            off is not None
+            and self._offload_requested(off)
+            and off.pipeline
+            and str(off.device.value) == "cpu"
+        )
+        if self._offload_requested(zcfg.offload_param):
             raise ValueError(
-                "compile.multi_step is incompatible with offloaded "
-                "optimizer/param state (the host owns those update loops)"
+                "compile.multi_step is incompatible with offload_param "
+                "(the layer stream owns the per-microbatch update loop)"
+            )
+        if self._offload_requested(off) and not streamed_opt_offload:
+            raise ValueError(
+                "compile.multi_step is incompatible with the LEGACY host-Adam "
+                "offload (the host owns that update loop); the streamed "
+                "ZeRO-Infinity path (offload_optimizer.device=cpu with "
+                "pipeline_read/pipeline_write) composes with windows"
             )
         if self.lr_scheduler is not None and not (
             hasattr(self.lr_scheduler, "state_dict")
@@ -2138,6 +2286,69 @@ class DeepSpeedEngine:
         self._scale_state = self.loss_scaler.update(self._scale_state, overflow_flag)
         self._overflow = overflow
 
+    def _take_streamed_offload_step(self, lr: float) -> None:
+        """ZeRO-Infinity streamed step (runtime/zero/host_offload.py): host
+        master/moments stream device-ward bucket by bucket through the
+        depth-2 pipeline, each donated bucket program applies the EXACT
+        on-device update math, and the updated slice streams back D2H while
+        the next bucket computes. fp16 overflow discards the staged uploads
+        and skips the bucket loop entirely — bit-identical to the fused
+        path's where-revert (everything keeps its pre-step value) without
+        paying the stream."""
+        ho = self._host_offload
+        nb = ho.num_buckets
+        # prime the double buffer: buckets 0 and 1 ride behind the backward
+        # still executing on the device stream
+        with self.tracer.span("train.offload_h2d", buckets=min(2, nb)):
+            ho.h2d_bucket(0)
+            if nb > 1:
+                ho.h2d_bucket(1)
+        scale = self._scale_state.scale
+        grad_norm, coef, overflow_flag = self._jit_offload_stats(self._grad_acc, scale)
+        self._last_grad_norm = grad_norm
+        overflow = bool(jax.device_get(overflow_flag)) if self._config.fp16_enabled else False
+        if overflow:
+            ho.discard_staged()
+            self._grad_acc = self._jit_zero_grads(self._grad_acc)
+        else:
+            acc_leaves = jax.tree_util.tree_leaves(self._grad_acc)
+            param_leaves = jax.tree_util.tree_leaves(self._params)
+            new_params = list(param_leaves)
+            new_acc = list(acc_leaves)
+            step = np.int32(ho.step_count)
+            for bi in range(nb):
+                idx = ho.bucket_indices(bi)
+                masters, ms, vs = ho.take_staged(bi)
+                accs = tuple(acc_leaves[i] for i in idx)
+                if self.mixed_precision:
+                    p_old = tuple(param_leaves[i] for i in idx)
+                    nm, nmm, nmv, np_b, za = self._jit_offload_bucket[bi](
+                        tuple(masters), tuple(ms), tuple(vs), accs, p_old, scale, coef, step, lr
+                    )
+                else:
+                    # fp32: the live params ARE the master slice
+                    p_old = tuple(param_leaves[i] for i in idx)
+                    nm, nmm, nmv, za = self._jit_offload_bucket[bi](
+                        p_old, tuple(ms), tuple(vs), accs, scale, coef, step, lr
+                    )
+                    np_b = nm
+                for k, i in enumerate(idx):
+                    new_params[i] = np_b[k]
+                    new_acc[i] = za[k]
+                if bi + 2 < nb:
+                    with self.tracer.span("train.offload_h2d", buckets=1):
+                        ho.h2d_bucket(bi + 2)
+                chaos.point("train.mid_offload_stream", bucket=bi)
+                with self.tracer.span("train.offload_d2h", bucket=bi):
+                    ho.d2h_bucket(bi, nm, nmm, nmv)
+                    ho.materialize_writes(keep=1)
+            self._params = ho.unflatten(new_params)
+            self._grad_acc = ho.unflatten(new_acc)
+            ho.step_count += 1
+        ho.note_step()
+        self._scale_state = self.loss_scaler.update(self._scale_state, overflow_flag)
+        self._overflow = overflow
+
     def _finish_step_bookkeeping(self, overflow_flag) -> None:
         """Post-update host tail shared by every step flavor: counters,
         fp16 overflow accounting (the only host-visible sync, and only under
@@ -2207,7 +2418,10 @@ class DeepSpeedEngine:
             self._finish_step_bookkeeping(overflow)
             return
         if self._host_offload is not None:
-            self._take_offload_step(lr)  # sets self._overflow itself
+            if self._streamed_offload:
+                self._take_streamed_offload_step(lr)  # sets self._overflow itself
+            else:
+                self._take_offload_step(lr)  # sets self._overflow itself
             self._finish_step_bookkeeping(self._overflow)
             return
         if self.mixed_precision:
@@ -2289,14 +2503,32 @@ class DeepSpeedEngine:
         from deepspeed_tpu.analysis import engine_analysis_report
 
         return engine_analysis_report(
-            self._telemetry, self._config.analysis_config, programs=programs, passes=passes
+            self._telemetry,
+            self._config.analysis_config,
+            programs=programs,
+            passes=passes,
+            extra_config=self._analysis_extra_config(),
         )
+
+    def _analysis_extra_config(self) -> Optional[Dict[str, Any]]:
+        """Engine-declared analysis-pass inputs: the streamed-offload engine
+        hands the overlap pass its H2D/D2H stream schedule so the pass can
+        account (and gate) the declared transfers next to the collectives."""
+        if self._streamed_offload and self._host_offload is not None:
+            return {"offload_stream": self._host_offload.stream_schedule()}
+        return None
 
     def _verify_program_static(self, name: str) -> None:
         """analysis.verify hook: passes over one freshly compiled program."""
         from deepspeed_tpu.analysis import verify_program
 
-        verify_program(self._telemetry, self._config.analysis_config, name, logger=logger)
+        verify_program(
+            self._telemetry,
+            self._config.analysis_config,
+            name,
+            logger=logger,
+            extra_config=self._analysis_extra_config(),
+        )
 
     def train_batch(self, data_iter=None, batch=None):
         """Convenience: run a full GAS cycle — gas × fwd/bwd + step, or,
@@ -2630,6 +2862,25 @@ class DeepSpeedEngine:
             with self.tracer.span("train.h2d"):
                 stacked = self._place_stacked_batch(micro)
             lrs = np.asarray(self._window_lrs(H), np.float32)
+            if self._streamed_offload:
+                # gather the full host-resident master/moments device-ward
+                # (bucketed H2D through the same stream helpers) so the
+                # window program scans the IDENTICAL fused step body; the
+                # updated state scatters back D2H after the window commits
+                ho = self._host_offload
+                with self.tracer.span("train.offload_h2d", window=True):
+                    g_masters, g_ms, g_vs = ho.gather_device_state()
+                if self.mixed_precision:
+                    self._master = ho.unflatten(g_masters)
+                else:
+                    self._master = self._params
+                self._opt_state = AdamState(
+                    step=jax.device_put(
+                        jnp.int32(ho.step_count), self._opt_shardings.step
+                    ),
+                    exp_avg=ho.unflatten(g_ms),
+                    exp_avg_sq=ho.unflatten(g_vs),
+                )
             window_name = f"fused_window_step_n{H}"
             with self.tracer.span("train.dispatch", program=window_name):
                 if self.mixed_precision:
@@ -2707,6 +2958,27 @@ class DeepSpeedEngine:
             # materialized by now (its compute finished while window i was
             # being formed), so this read does not block the pipeline
             self._drain_pending(keep=1)
+        if self._streamed_offload:
+            # scatter the window's updated master/moments back to the host
+            # buffers; overflow-skipped steps never advanced opt.step inside
+            # the window (the where-revert restores it), so the host step
+            # counter advances by the taken steps only
+            ho = self._host_offload
+            taken = (
+                H - sum(1 for r in recs if r["ovf"])
+                if self._config.fp16_enabled
+                else H
+            )
+            with self.tracer.span("train.offload_d2h", window=True):
+                ho.scatter_device_state(
+                    jax.tree_util.tree_leaves(self._master),
+                    jax.tree_util.tree_leaves(self._opt_state.exp_avg),
+                    jax.tree_util.tree_leaves(self._opt_state.exp_avg_sq),
+                    taken,
+                )
+            # the host copies are authoritative again; drop the device set
+            self._master = None
+            self._opt_state = None
         self._window_stash.extend(recs)
         return self._commit_window_step()
 
@@ -2778,11 +3050,13 @@ class DeepSpeedEngine:
         number the windows exist to drive to 1/N."""
         stats = self._telemetry.stats()
         step_programs = {"fwd_bwd", "step", "fused_step", "fused_accum_step",
-                         "grad_stats", "zero_grads"}
+                         "grad_stats", "offload_stats", "zero_grads"}
         dispatches = sum(
             rec["dispatches"]
             for name, rec in stats.items()
-            if name in step_programs or name.startswith("fused_window_step")
+            if name in step_programs
+            or name.startswith("fused_window_step")
+            or name.startswith("offload_bucket_update")
         )
         return {
             "multi_step_enabled": self._window_armed,
@@ -2799,6 +3073,18 @@ class DeepSpeedEngine:
             "stashed_steps": len(self._window_stash),
             "drained_dropped": self._drained_dropped,
         }
+
+    def offload_stream_stats(self) -> Optional[Dict[str, Any]]:
+        """Cumulative H2D/D2H stream accounting for the streamed host
+        offload path (``HostOffloadStreamer.stream_stats()``): wall time
+        spent issuing async copies, wall time EXPOSED (blocking waits the
+        pipeline knobs could not hide), bytes each way, and optimizer
+        steps taken. ``None`` when the streamed path is not active —
+        including before the first ``train_batch`` (initialization is
+        lazy)."""
+        if not self._streamed_offload or self._host_offload is None:
+            return None
+        return self._host_offload.stream_stats()
 
     def _data_cursor_state(self):
         """The data cursor a checkpoint should carry. When the prefetching

@@ -97,10 +97,23 @@ class DeepSpeedZeroConfig(DeepSpeedConfigModel):
     @model_validator(mode="before")
     @classmethod
     def _legacy_cpu_offload(cls, values):
+        """Deprecated ``cpu_offload*`` keys route to the real offload path —
+        never parse-then-silently-no-op (ISSUE 16 config hygiene)."""
         if isinstance(values, dict):
+            pin = values.pop("cpu_offload_use_pin_memory", None)
             if values.pop("cpu_offload", None):
                 values.setdefault("offload_optimizer", {"device": OffloadDeviceEnum.cpu})
             if values.pop("cpu_offload_param", None):
                 values.setdefault("offload_param", {"device": OffloadDeviceEnum.cpu})
-            values.pop("cpu_offload_use_pin_memory", None)
+            if pin is not None:
+                off = values.get("offload_optimizer")
+                if isinstance(off, dict):
+                    off.setdefault("pin_memory", bool(pin))
+                elif off is None:
+                    raise ValueError(
+                        "cpu_offload_use_pin_memory is set but no offloaded "
+                        "optimizer is configured (cpu_offload or "
+                        "offload_optimizer.device); the knob would be silently "
+                        "ignored — remove it or configure offload_optimizer"
+                    )
         return values

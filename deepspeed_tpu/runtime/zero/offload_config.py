@@ -38,7 +38,13 @@ class DeepSpeedZeroOffloadOptimizerConfig(DeepSpeedConfigModel):
     pipeline_write: bool = False
     fast_init: bool = False
     ratio: float = Field(1.0, ge=0.0, le=1.0)
+    # streamed (ZeRO-Infinity) path: elements per H2D/D2H bucket — the unit
+    # the fp32 master + moments stream through the depth-2 pipeline in
+    # (runtime/zero/host_offload.py). Same units as reduce_bucket_size.
+    bucket_size: int = Field(pp_int(int(5e7)), ge=1)
 
     @property
     def pipeline(self) -> bool:
+        """True selects the STREAMED offload engine (host buffers + donated
+        per-bucket device update) over the legacy host-Adam path."""
         return self.pipeline_read or self.pipeline_write

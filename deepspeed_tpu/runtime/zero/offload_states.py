@@ -274,7 +274,20 @@ class HostOffloadAdam:
             self.swapper.drain_writes()
         return state
 
+    def drain_writes(self) -> None:
+        """Write fence — streamed-engine (host_offload.py) API parity so the
+        checkpoint path can fence either flavor; the legacy path has no
+        deferred writebacks (``state_dict()`` drains the swapper inline)."""
+
     def load_state_dict(self, state: Dict[str, Any]) -> None:
+        if isinstance(state, dict) and state.get("format") == "streamed":
+            raise ValueError(
+                "this checkpoint's offloaded optimizer state was saved by the "
+                "STREAMED ZeRO-Infinity engine (runtime/zero/host_offload.py); "
+                "load it with offload_optimizer.pipeline_read/pipeline_write "
+                "enabled, or pass load_optimizer_states=False to adopt the "
+                "module weights only"
+            )
         self.step_count = int(state["step"])
         for li, per in enumerate(state["leaves"]):
             for sh, rec in zip(self._shards[li], per):
@@ -291,6 +304,12 @@ class HostOffloadAdam:
 
     def load_master_only(self, state: Dict[str, Any]) -> None:
         """Restore just the fp32 master (module-only checkpoint load)."""
+        if isinstance(state, dict) and state.get("format") == "streamed":
+            raise ValueError(
+                "streamed-format (ZeRO-Infinity) offload checkpoint cannot "
+                "restore into the legacy host-Adam engine; enable "
+                "offload_optimizer.pipeline_read/pipeline_write to load it"
+            )
         for li, per in enumerate(state["leaves"]):
             for sh, rec in zip(self._shards[li], per):
                 sh.master[:] = np.asarray(rec["master"], np.float32).ravel()

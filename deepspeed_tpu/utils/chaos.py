@@ -94,6 +94,11 @@ POINTS = (
     # window's tokens are buffered in the journal, none yet acked
     "train.mid_window",  # training window dispatched + state adopted, loss
     # drain not yet run and no step of the window committed to the counters
+    "train.mid_offload_stream",  # ZeRO-Infinity streamed step, mid-bucket:
+    # some host offload buffers updated, others not, the step uncommitted —
+    # resume must rebuild the host state from the last checkpoint, never
+    # trust the torn buffers
+
     "journal.append",
     "fleet.replica_kill",  # one replica's turn in the fleet step loop: raise =
     # that replica dies (router survives + re-routes), exit = whole process

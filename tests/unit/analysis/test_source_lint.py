@@ -555,6 +555,93 @@ def test_r009_pragma_suppresses_and_is_error_severity():
     assert resolve_severity(f) == "error"
 
 
+def test_r009_streamer_stream_family_in_scope():
+    """ISSUE 16 extension: the host-offload *Streamer bucket methods run
+    between every hot dispatch — a raw clock there forks the timeline
+    exactly like one in an engine step method. Red."""
+    findings = _rules("""
+        import time
+        class HostOffloadStreamer:
+            def h2d_bucket(self, bi):
+                t0 = time.perf_counter()
+            def d2h_bucket(self, bi, m, ea, eas):
+                return time.time()
+            def materialize_writes(self, keep=0):
+                time.monotonic()
+            def gather_device_state(self):
+                device_sync()
+        class FooEngine:
+            def _take_streamed_offload_step(self, lr):
+                return time.perf_counter()
+    """)
+    assert findings.count("DS-R009") == 5
+
+
+def test_r009_streamer_unsanctioned_host_copy_flagged():
+    """Stream-copy discipline: a raw device_put / device_get /
+    copy_to_host_async / block_until_ready anywhere in a *Streamer
+    OUTSIDE the sanctioned helpers bypasses the stream accounting the
+    overlap gate audits. Red on each copy primitive."""
+    findings = _rules("""
+        import jax
+        class HostOffloadStreamer:
+            def take_staged(self, bi):
+                return jax.device_put(self._exp_avg[0], s)
+            def stream_stats(self):
+                return jax.device_get(self._pending[0][1])
+            def state_dict(self):
+                arr.copy_to_host_async()
+            def note_step(self):
+                x.block_until_ready()
+    """)
+    assert findings.count("DS-R009") == 4
+
+
+def test_r009_streamer_sanctioned_helpers_quiet():
+    """The sanctioned stream helpers OWN the raw copies (that is the
+    point of the rule); __init__ seeds host buffers before stepping and
+    set_master_leaves is checkpoint-restore surgery. All green — and the
+    real streamer module holds the contract."""
+    assert "DS-R009" not in _rules("""
+        import jax
+        import numpy as np
+        class HostOffloadStreamer:
+            def __init__(self, tree):
+                self._master = [np.array(jax.device_get(l), copy=True) for l in tree]
+            def h2d_bucket(self, bi):
+                return [jax.device_put(m, s) for m in self._exp_avg]
+            def d2h_bucket(self, bi, m, ea, eas):
+                m[0].copy_to_host_async()
+            def _land(self, bufs, i, arr):
+                np.copyto(bufs[i], np.asarray(jax.device_get(arr)))
+            def drain_writes(self):
+                arr.block_until_ready()
+            def set_master_leaves(self, leaves):
+                np.copyto(self._master[0], np.asarray(jax.device_get(leaves[0])))
+        class BucketPlanner:
+            def take_staged(self):
+                return jax.device_put(x, s)  # only *Streamer classes are in scope
+    """)
+    path = os.path.join(REPO, "deepspeed_tpu", "runtime", "zero", "host_offload.py")
+    findings = lint_paths([path])
+    assert [f.rule for f in findings] == [], [f.render() for f in findings]
+
+
+def test_r008_host_offload_is_a_persistence_path():
+    """host_offload.py persists state checkpoints later trust — a raw
+    open('w') there is in DS-R008 scope by path."""
+    src = """
+        def dump(path, payload):
+            with open(path, "w") as fh:
+                fh.write(payload)
+    """
+    hits = [
+        f.rule
+        for f in lint_source(textwrap.dedent(src), path="deepspeed_tpu/runtime/zero/host_offload.py")
+    ]
+    assert hits == ["DS-R008"]
+
+
 def test_r010_jax_import_in_host_only_module_flagged():
     """The fleet router and the tracer are declared pure host code: any
     jax import form trips the rule there — and only there."""

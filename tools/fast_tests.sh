@@ -93,6 +93,21 @@
 # value identity, mid-window protocol guards, window-program green sweep
 # (full state tuple donated THROUGH the lax.scan carry, 0 in-program host
 # transfers).
+# +ZeRO-Infinity streamed host offload 2026-08-07 (test_host_offload.py
+# rides the tests/unit/runtime/zero dir below; test_passes.py::
+# test_green_infinity_offload_program rides the lint.sh analysis suite;
+# DS-R008/DS-R009 Streamer-family lint extensions ride
+# test_source_lint.py): fp32 master + Adam moments live in pinned host
+# buffers and stream per-bucket through a depth-2 double-buffered async
+# pipeline — streamed vs on-device BIT-identical losses/master across
+# zero{1,3} × {fp32,bf16,fp16-forced-overflow} × gas{1,2}, fully-windowed
+# multi_step bit-identity (same window trace both engines), declared
+# stream schedule == measured bytes + 0 exposed ms with both pipeline
+# knobs on / red overlap verdict with pipeline_write off, host-resident
+# checkpoint snapshot roundtrip + streamed/legacy format guards,
+# train.mid_offload_stream chaos kill → auto_resume bit-identical,
+# legacy cpu_offload* config-routing red tests, bench bisection-probe
+# unit.
 cd "$(dirname "$0")/.." || exit 1
 sh tools/lint.sh || exit 1
 exec python -m pytest -q \
