@@ -216,6 +216,29 @@ def _analysis_fields(engine):
         return {"analysis_error": f"{type(e).__name__}: {e}"[:200]}
 
 
+def _memory_fields(engine):
+    """Static HBM-ledger summary for the result record (ISSUE 18): the
+    engine's whole-run per-chip residency peak (persistent buffers + the
+    largest program's transient footprint, from ``memory_report`` with
+    the per-program estimates folded in), the bytes sitting fully
+    replicated across the mesh, and the ``analysis.hbm_budget_bytes``
+    verdict (None when no budget is configured). On the CPU bench backend
+    the estimator's temp bytes are a lower bound (see PERF.md). Runs after
+    the timed window — folding the programs re-traces each one once."""
+    try:
+        led = engine.memory_report(include_programs=True, enforce=False)
+        return {
+            "peak_hbm_bytes_per_chip": int(led["peak_hbm_bytes_per_chip"]),
+            "replicated_bytes": int(led["replicated_bytes"]),
+            "hbm_budget_verified": led["hbm_budget_verified"],
+        }
+    except Exception as e:
+        # same contract as _analysis_fields: never fail the record, never
+        # vanish silently
+        traceback.print_exc()
+        return {"memory_error": f"{type(e).__name__}: {e}"[:200]}
+
+
 def _trace_fields(engine, name, timed_window=None, overhead_reps=8):
     """Unified-tracing fields for a result record (ISSUE 10):
 
@@ -506,6 +529,7 @@ def bench_gpt2_zero1():
     }
     rec.update(_compile_fields(engine))
     rec.update(_analysis_fields(engine))
+    rec.update(_memory_fields(engine))
     rec.update(_ckpt_fields(engine))
     rec.update(
         _trace_fields(
@@ -605,6 +629,7 @@ def bench_llama_zero3():
     }
     rec.update(_compile_fields(engine))
     rec.update(_analysis_fields(engine))
+    rec.update(_memory_fields(engine))
     rec.update(_ckpt_fields(engine))
 
     def _ms_engine(ms_on, horizon):
@@ -1046,6 +1071,7 @@ def bench_decode_serving():
     # comparison set), not kv_decode_loop
     compile_fields = _compile_fields(engine)
     compile_fields.update(_analysis_fields(engine))
+    compile_fields.update(_memory_fields(engine))
     # unified-tracing fields for the measured (ragged, spec-off) server:
     # phase breakdown + overhead A/B + the Perfetto trace artifact. The
     # timed window returns seconds-per-token (1/tps), so the on/off ratio
@@ -1214,7 +1240,37 @@ def bench_decode_serving_tp():
             qs = prog["passes"]["collectives"]["summary"]["quantized"]
             q_wire += qs["wire_bytes"]
             q_fp_equiv += qs["fp_equiv_wire_bytes"]
+    # static HBM ledger fields (ISSUE 18). No engine wraps this server, so
+    # the per-chip program peak / replicated bytes come from the memory
+    # pass over the quantized arm's telemetry — audited against the tp
+    # plan's declared sharding rules + comm schedule — and the KV-pool
+    # residency straight from the pool (bytes/chip == total/tp with the
+    # page tables host-side: the ledger gate's serving invariant).
+    try:
+        q_srv = getattr(q_server, "server", q_server)
+        pool_rep = q_srv.pool.memory_report()
+        mem_cfg = None
+        if q_srv.tp is not None and q_srv.tp.degree > 1:
+            mem_cfg = {
+                "declared_collectives": q_srv.tp.declared_collectives(),
+                "sharding_rules": q_srv.tp.sharding_rules(),
+            }
+        mem_tot = run_program_passes(q_tel, passes=["memory"], config=mem_cfg)[
+            "totals"
+        ]
+        mem_fields = {
+            "peak_hbm_bytes_per_chip": int(mem_tot["peak_hbm_bytes_per_chip"]),
+            "replicated_bytes": int(mem_tot["replicated_bytes"]),
+            # no analysis.hbm_budget_bytes configured for the bench arms
+            "hbm_budget_verified": None,
+            "kv_bytes_per_chip": int(pool_rep["kv_bytes_per_chip"]),
+            "undeclared_collectives": int(mem_tot["undeclared_collectives"]),
+        }
+    except Exception as e:
+        traceback.print_exc()
+        mem_fields = {"memory_error": f"{type(e).__name__}: {e}"[:200]}
     return {
+        **mem_fields,
         "metric": METRICS["decode_serving_tp"],
         "value": round(per_chip, 1),
         "unit": "tokens/s/chip",

@@ -12,6 +12,10 @@ Two layers:
 * **Source lint** (``source_lint.py``, CLI: ``tools/lint.py``) is an AST
   pass over the repo encoding python-level hazards (repeat-on-cache, host
   syncs inside jit, shape branches, undonated buffers).
+
+``memory.py`` adds the static HBM layer on top of both: a per-program
+peak-HBM estimator, a sharding auditor, and the whole-run residency
+ledger behind ``engine.memory_report()`` / ``analysis.hbm_budget_bytes``.
 """
 
 from .passes import (  # noqa: F401
@@ -28,6 +32,14 @@ from .passes import (  # noqa: F401
     host_transfer_pass,
     iter_eqns,
     overlap_pass,
+)
+from .memory import (  # noqa: F401
+    HbmBudgetError,
+    MemoryLedger,
+    audit_sharding,
+    estimate_program_memory,
+    memory_pass,
+    tree_device_bytes,
 )
 from .report import (  # noqa: F401
     diff_trace_signatures,

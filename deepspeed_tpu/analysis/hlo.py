@@ -124,6 +124,55 @@ def parse_input_output_aliases(hlo_text: str) -> Set[int]:
     return {int(p) for p in re.findall(r":\s*\(\s*(\d+)", m.group(1))}
 
 
+_PARAM_LINE_RE = re.compile(
+    r"=\s*((?:\((?:[^()]|\([^()]*\))*\))|[\w\[\]{},]+)\s+parameter\((\d+)\)"
+)
+_ENTRY_RESULT_RE = re.compile(r"->\s*(.*?)\s*\{\s*$")
+
+
+def entry_parameter_shapes(hlo_text: str) -> Dict[int, str]:
+    """{parameter index: shape string} of the ENTRY computation — the
+    per-chip input buffers of the compiled executable (shapes in optimized
+    SPMD HLO are per-partition). Best-effort: unparseable lines drop out."""
+    out: Dict[int, str] = {}
+    in_entry = False
+    for line in hlo_text.splitlines():
+        if line.startswith("ENTRY "):
+            in_entry = True
+            continue
+        if not in_entry:
+            continue
+        m = _PARAM_LINE_RE.search(line)
+        if m:
+            out[int(m.group(2))] = m.group(1)
+        if line.strip() == "}":
+            break
+    return out
+
+
+def entry_result_shape(hlo_text: str) -> Optional[str]:
+    """Shape string of the ENTRY computation's result (the ``-> shape {``
+    of its header; falls back to the ROOT instruction line), or None."""
+    in_entry = False
+    for line in hlo_text.splitlines():
+        if line.startswith("ENTRY "):
+            in_entry = True
+            m = _ENTRY_RESULT_RE.search(line)
+            if m:
+                return m.group(1)
+            continue
+        if not in_entry:
+            continue
+        s = line.strip()
+        if s.startswith("ROOT "):
+            m = re.search(r"=\s*((?:\((?:[^()]|\([^()]*\))*\))|[\w\[\]{},]+)\s+", s)
+            if m:
+                return m.group(1)
+        if s == "}":
+            break
+    return None
+
+
 def entry_parameter_count(hlo_text: str) -> Optional[int]:
     """Number of entry-computation parameters, or None if unparseable.
     Used to detect argument pruning (``len(flat args_info)`` mismatch)."""

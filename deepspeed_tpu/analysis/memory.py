@@ -1,0 +1,473 @@
+"""Static HBM ledger & sharding auditor (ISSUE 18).
+
+Every recent tentpole made a memory claim the analysis layer could not
+check: the streamed ZeRO-Infinity offload promises ~2-bucket device
+residency, tensor-parallel serving promises KV pools sharded per chip with
+only host-side page tables replicated — and PR 12's review caught, by
+hand, a transient whole-pool-on-one-chip allocation. This module turns
+those claims into statically verified invariants, three layers deep:
+
+* :func:`estimate_program_memory` — per-program peak-HBM estimate. The
+  executable's own ``memory_analysis()`` is preferred when the backend
+  provides it (argument/output/temp/alias bytes straight from the buffer
+  assignment); otherwise an optimized-HLO buffer walk reconstructs the
+  same accounting from the ENTRY parameter/result shapes with donation
+  aliases deduplicated via the ``input_output_alias`` table the donation
+  pass already parses. Shapes in optimized SPMD HLO are per-partition, so
+  every number is bytes **per chip**. On backends whose buffer assignment
+  reports no temporaries (the CPU test backend) the estimate is a lower
+  bound — PERF.md's memory-ledger round carries the disclaimer.
+* :func:`audit_sharding` — per-buffer per-chip bytes from the sharding
+  annotations of the program's captured abstract call signature, flagging
+  (a) large leaves left fully replicated on a multi-chip mesh when a
+  declared sharding rule says they shard, and (b) collective op kinds in
+  the compiled module that the engine's declared comm schedule does not
+  contain — the pjit-inserted resharding all-gathers that silently
+  re-materialize a sharded buffer whole.
+* :class:`MemoryLedger` — whole-run residency aggregation across the
+  engine's persistent buffers (params, optimizer state, paged KV pools,
+  offload device buckets — device or host resident) plus the live
+  programs' transient footprints, surfaced as ``engine.memory_report()``
+  and gated by ``analysis.hbm_budget_bytes`` (``off|warn|raise`` via
+  ``analysis.hbm_budget``, like ``analysis.verify``). An over-budget
+  ledger raises :class:`HbmBudgetError` with per-buffer attribution.
+
+``memory_pass`` registers the estimator + auditor as the ``"memory"``
+program pass: with no budget/rules/declared-schedule configured it is
+summary-only (zero violations), so existing green sweeps stay green.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from . import hlo as hlo_parse
+from .passes import (
+    PROGRAM_PASSES,
+    AnalysisError,
+    PassResult,
+    ProgramArtifact,
+    Violation,
+)
+
+
+class HbmBudgetError(AnalysisError):
+    """Raised by ``analysis.hbm_budget: raise`` when the residency ledger's
+    per-chip peak exceeds ``analysis.hbm_budget_bytes``. The message
+    carries per-buffer attribution (largest entries first)."""
+
+
+def _nbytes(shape: Sequence[int], dtype) -> int:
+    n = 1
+    for d in shape:
+        n *= int(d)
+    try:
+        return n * int(np.dtype(dtype).itemsize)
+    except Exception:
+        return n * 4
+
+
+# ---------------------------------------------------------------------------
+# per-program peak-HBM estimator
+# ---------------------------------------------------------------------------
+def estimate_program_memory(art: ProgramArtifact) -> Dict[str, Any]:
+    """Peak-HBM estimate (bytes per chip) for one compiled program.
+
+    ``peak_hbm_bytes = argument + output + temp - alias``: aliased outputs
+    (honored donations) reuse their argument's buffer, so they are counted
+    once. ``source`` says which accounting produced the numbers —
+    ``"memory_analysis"`` (the executable's buffer assignment) or
+    ``"hlo_walk"`` (text fallback, ``temp_bytes`` unknowable → 0, making
+    the estimate a lower bound)."""
+    stats = None
+    try:
+        stats = art.compiled.memory_analysis()
+    except Exception:
+        stats = None
+    if stats is not None:
+        try:
+            arg = int(stats.argument_size_in_bytes)
+            out = int(stats.output_size_in_bytes)
+            tmp = int(stats.temp_size_in_bytes)
+            alias = int(stats.alias_size_in_bytes)
+            return {
+                "source": "memory_analysis",
+                "argument_bytes": arg,
+                "output_bytes": out,
+                "temp_bytes": tmp,
+                "alias_bytes": alias,
+                "generated_code_bytes": int(
+                    getattr(stats, "generated_code_size_in_bytes", 0) or 0
+                ),
+                "peak_hbm_bytes": max(arg + out + tmp - alias, 0),
+            }
+        except Exception:
+            pass
+    # optimized-HLO buffer walk: ENTRY parameter shapes are the argument
+    # buffers, the ENTRY result shape the outputs, and the header's
+    # input_output_alias table (the donation pass's machinery) names the
+    # parameters whose bytes the outputs reuse
+    text = art.hlo_text
+    params = hlo_parse.entry_parameter_shapes(text)
+    arg = sum(hlo_parse.shape_list_bytes(s) for s in params.values())
+    result = hlo_parse.entry_result_shape(text)
+    out = hlo_parse.shape_list_bytes(result) if result else 0
+    aliased = hlo_parse.parse_input_output_aliases(text)
+    alias = sum(
+        hlo_parse.shape_list_bytes(params[i]) for i in aliased if i in params
+    )
+    return {
+        "source": "hlo_walk",
+        "argument_bytes": arg,
+        "output_bytes": out,
+        "temp_bytes": 0,  # not recoverable from text: lower bound
+        "alias_bytes": alias,
+        "generated_code_bytes": 0,
+        "peak_hbm_bytes": max(arg + out - alias, 0),
+    }
+
+
+# ---------------------------------------------------------------------------
+# sharding auditor
+# ---------------------------------------------------------------------------
+def _signature_buffers(art: ProgramArtifact) -> List[Dict[str, Any]]:
+    """Flat per-argument buffer records from the program's captured
+    abstract call signature: arg path, global/per-chip bytes, and whether
+    the leaf's DECLARED sharding leaves it fully replicated on a
+    multi-chip placement. Leaves without a sharding (uncommitted host
+    arrays jit replicates at dispatch) report ``devices=None``."""
+    sig = getattr(art._wrapper, "abstract_signature", None)
+    if sig is None:
+        return []
+    flat, _ = jax.tree_util.tree_flatten_with_path(sig)
+    out: List[Dict[str, Any]] = []
+    for path, leaf in flat:
+        if not (hasattr(leaf, "shape") and hasattr(leaf, "dtype")):
+            continue
+        shape = tuple(leaf.shape)
+        total = _nbytes(shape, leaf.dtype)
+        sharding = getattr(leaf, "sharding", None)
+        per_chip = total
+        devices = None
+        replicated = False
+        if sharding is not None:
+            try:
+                devices = int(sharding.num_devices)
+                per_chip = _nbytes(sharding.shard_shape(shape), leaf.dtype)
+                replicated = devices > 1 and per_chip == total
+            except Exception:
+                devices = None
+        out.append(
+            {
+                "arg": jax.tree_util.keystr(path),
+                "shape": shape,
+                "dtype": str(leaf.dtype),
+                "global_bytes": total,
+                "per_chip_bytes": per_chip,
+                "devices": devices,
+                "replicated": replicated,
+            }
+        )
+    return out
+
+
+def audit_sharding(
+    art: ProgramArtifact,
+    rules: Optional[Sequence[Dict[str, Any]]] = None,
+    declared_collectives: Optional[Sequence[str]] = None,
+) -> Tuple[Dict[str, Any], List[Violation]]:
+    """Audit one mesh program's buffer placement against its declared
+    sharding contract.
+
+    ``rules`` — each ``{"pattern": regex-on-arg-path, "min_bytes": int,
+    "rank": optional int}`` declares "leaves matching this are supposed to
+    shard": a matching leaf ≥ ``min_bytes`` left fully replicated on a
+    multi-chip placement is an error-severity violation (the whole-pool-
+    on-every-chip class). ``declared_collectives`` — the collective op
+    kinds the engine's comm schedule intentionally contains; any other
+    kind found in the compiled module is an undeclared resharding
+    collective (pjit re-materializing a sharded buffer), error severity.
+    Both inputs default to None = audit summarizes, flags nothing."""
+    buffers = _signature_buffers(art)
+    violations: List[Violation] = []
+    mesh_devices = max((b["devices"] or 1) for b in buffers) if buffers else 1
+    replicated_bytes = sum(
+        b["per_chip_bytes"] for b in buffers if b["replicated"]
+    )
+    sharded_bytes = sum(
+        b["per_chip_bytes"]
+        for b in buffers
+        if b["devices"] is not None and not b["replicated"]
+    )
+    summary: Dict[str, Any] = {
+        "buffers": len(buffers),
+        "mesh_devices": mesh_devices,
+        "per_chip_arg_bytes": sum(b["per_chip_bytes"] for b in buffers),
+        "replicated_bytes": replicated_bytes,
+        "sharded_bytes": sharded_bytes,
+    }
+    for rule in rules or ():
+        pat = re.compile(rule.get("pattern", ""))
+        min_bytes = int(rule.get("min_bytes", 0))
+        want_rank = rule.get("rank")
+        for b in buffers:
+            if not b["replicated"] or b["global_bytes"] < min_bytes:
+                continue
+            if want_rank is not None and len(b["shape"]) != want_rank:
+                continue
+            if not pat.search(b["arg"]):
+                continue
+            violations.append(
+                Violation(
+                    "memory",
+                    art.name,
+                    f"arg {b['arg']} ({b['dtype']}{list(b['shape'])}, "
+                    f"{b['global_bytes']} bytes) is fully replicated across "
+                    f"{b['devices']} chips but the declared sharding rule "
+                    f"{rule.get('pattern')!r} says it shards — every chip "
+                    "pays the whole buffer",
+                    details={"arg": b["arg"], "bytes": b["global_bytes"],
+                             "rule": dict(rule)},
+                )
+            )
+    undeclared: List[Dict[str, Any]] = []
+    if declared_collectives is not None:
+        declared = set(declared_collectives)
+        seen: Dict[str, Dict[str, int]] = {}
+        for d in hlo_parse.collect_collective_details(art.hlo_text):
+            rec = seen.setdefault(d["op"], {"count": 0, "bytes": 0})
+            rec["count"] += 1
+            rec["bytes"] += d["bytes"]
+        for op, rec in sorted(seen.items()):
+            if op in declared:
+                continue
+            undeclared.append({"op": op, **rec})
+            violations.append(
+                Violation(
+                    "memory",
+                    art.name,
+                    f"{rec['count']} {op} collective(s) ({rec['bytes']} "
+                    "bytes/device) in the compiled module are absent from "
+                    "the declared comm schedule: pjit inserted a resharding "
+                    "exchange the engine never planned (a sharded buffer is "
+                    "being re-materialized)",
+                    details={"op": op, **rec, "declared": sorted(declared)},
+                )
+            )
+        summary["declared_collectives"] = sorted(declared)
+    summary["undeclared_collectives"] = undeclared
+    return summary, violations
+
+
+# ---------------------------------------------------------------------------
+# the "memory" program pass
+# ---------------------------------------------------------------------------
+def memory_pass(
+    art: ProgramArtifact, config: Optional[Dict[str, Any]] = None
+) -> PassResult:
+    """Per-program memory pass: the peak-HBM estimate plus the sharding
+    audit. With no ``sharding_rules`` / ``declared_collectives`` /
+    ``hbm_budget_bytes`` configured the pass is summary-only."""
+    cfg = config or {}
+    res = PassResult()
+    est = estimate_program_memory(art)
+    audit_summary, violations = audit_sharding(
+        art,
+        rules=cfg.get("sharding_rules"),
+        declared_collectives=cfg.get("declared_collectives"),
+    )
+    res.summary = {"estimate": est, "sharding": audit_summary}
+    res.violations.extend(violations)
+    budget = cfg.get("hbm_budget_bytes")
+    mode = cfg.get("hbm_budget", "raise")
+    if budget is not None and mode != "off" and est["peak_hbm_bytes"] > int(budget):
+        res.violations.append(
+            Violation(
+                "memory",
+                art.name,
+                f"static peak HBM estimate {est['peak_hbm_bytes']} bytes/chip "
+                f"exceeds analysis.hbm_budget_bytes={int(budget)} "
+                f"(args={est['argument_bytes']} out={est['output_bytes']} "
+                f"temp={est['temp_bytes']} alias={est['alias_bytes']})",
+                severity="error" if mode == "raise" else "warn",
+                details={"estimate": est, "budget": int(budget)},
+            )
+        )
+    return res
+
+
+PROGRAM_PASSES.setdefault("memory", memory_pass)
+
+
+# ---------------------------------------------------------------------------
+# whole-run residency ledger
+# ---------------------------------------------------------------------------
+def tree_device_bytes(tree) -> Dict[str, int]:
+    """Byte accounting of a pytree of (possibly sharded) arrays:
+    ``global_bytes`` (logical), ``per_chip_bytes`` (one device's shard —
+    falls back to global when unsharded), and ``replicated_bytes`` (the
+    per-chip bytes of leaves placed on >1 device but not partitioned —
+    the footprint a sharding rule could reclaim)."""
+    total = per_chip = replicated = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if not (hasattr(leaf, "shape") and hasattr(leaf, "dtype")):
+            continue
+        g = _nbytes(tuple(leaf.shape), leaf.dtype)
+        p = g
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None:
+            try:
+                p = _nbytes(sharding.shard_shape(tuple(leaf.shape)), leaf.dtype)
+                if int(sharding.num_devices) > 1 and p == g:
+                    replicated += p
+            except Exception:
+                p = g
+        total += g
+        per_chip += p
+    return {
+        "global_bytes": total,
+        "per_chip_bytes": per_chip,
+        "replicated_bytes": replicated,
+    }
+
+
+class MemoryLedger:
+    """Engine-level HBM residency ledger: persistent buffers (device- or
+    host-resident) plus per-program transient estimates, with the
+    ``analysis.hbm_budget_bytes`` gate.
+
+    Peak model: the engine's programs run one at a time, and a program's
+    argument buffers ARE the persistent entries (params, optimizer state,
+    KV pools) already on the ledger — so the whole-run per-chip peak is
+
+        persistent_device_bytes + max over programs of
+            (temp_bytes + max(output_bytes - alias_bytes, 0))
+
+    (un-aliased outputs and temporaries are the only bytes a dispatch adds
+    on top of what already lives in HBM)."""
+
+    def __init__(
+        self,
+        hbm_budget_bytes: Optional[int] = None,
+        mode: str = "raise",
+    ):
+        self.hbm_budget_bytes = (
+            int(hbm_budget_bytes) if hbm_budget_bytes is not None else None
+        )
+        self.mode = mode
+        self.entries: List[Dict[str, Any]] = []
+        self.programs: Dict[str, Dict[str, Any]] = {}
+
+    def add_persistent(
+        self,
+        name: str,
+        *,
+        per_chip_bytes: int,
+        global_bytes: Optional[int] = None,
+        replicated_bytes: int = 0,
+        location: str = "device",
+        kind: str = "buffer",
+        detail: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        if location not in ("device", "host"):
+            raise ValueError(f"location must be device|host, got {location!r}")
+        self.entries.append(
+            {
+                "name": name,
+                "kind": kind,
+                "location": location,
+                "per_chip_bytes": int(per_chip_bytes),
+                "global_bytes": int(
+                    global_bytes if global_bytes is not None else per_chip_bytes
+                ),
+                "replicated_bytes": int(replicated_bytes),
+                "detail": detail or {},
+            }
+        )
+
+    def add_tree(self, name: str, tree, *, kind: str = "buffer") -> None:
+        """Convenience: account a pytree of device arrays as one entry."""
+        acct = tree_device_bytes(tree)
+        self.add_persistent(
+            name,
+            per_chip_bytes=acct["per_chip_bytes"],
+            global_bytes=acct["global_bytes"],
+            replicated_bytes=acct["replicated_bytes"],
+            kind=kind,
+        )
+
+    def add_program(self, name: str, estimate: Dict[str, Any]) -> None:
+        self.programs[name] = dict(estimate)
+
+    # -- aggregation -----------------------------------------------------
+    def report(self) -> Dict[str, Any]:
+        device = [e for e in self.entries if e["location"] == "device"]
+        host = [e for e in self.entries if e["location"] == "host"]
+        persistent_device = sum(e["per_chip_bytes"] for e in device)
+        transient = 0
+        transient_program = None
+        for name, est in self.programs.items():
+            t = int(est.get("temp_bytes", 0)) + max(
+                int(est.get("output_bytes", 0)) - int(est.get("alias_bytes", 0)),
+                0,
+            )
+            if t >= transient:
+                transient, transient_program = t, name
+        peak = persistent_device + transient
+        budget = self.hbm_budget_bytes
+        verified: Optional[bool] = None
+        if budget is not None and self.mode != "off":
+            verified = peak <= budget
+        return {
+            "entries": [dict(e) for e in self.entries],
+            "programs": {n: dict(e) for n, e in self.programs.items()},
+            "persistent_device_bytes_per_chip": persistent_device,
+            "host_bytes": sum(e["per_chip_bytes"] for e in host),
+            "replicated_bytes": sum(e["replicated_bytes"] for e in self.entries),
+            "transient_program_bytes": transient,
+            "transient_program": transient_program,
+            "peak_hbm_bytes_per_chip": peak,
+            "hbm_budget_bytes": budget,
+            "hbm_budget": self.mode,
+            "hbm_budget_verified": verified,
+        }
+
+    def _attribution(self, report: Dict[str, Any]) -> str:
+        lines = []
+        device = sorted(
+            (e for e in report["entries"] if e["location"] == "device"),
+            key=lambda e: -e["per_chip_bytes"],
+        )
+        for e in device:
+            lines.append(
+                f"  {e['name']} ({e['kind']}): {e['per_chip_bytes']} "
+                "bytes/chip on device"
+            )
+        if report["transient_program"]:
+            lines.append(
+                f"  program {report['transient_program']}: "
+                f"{report['transient_program_bytes']} transient bytes/chip"
+            )
+        return "\n".join(lines)
+
+    def enforce(self, logger=None) -> Dict[str, Any]:
+        """Build the report and apply the budget gate: ``raise`` →
+        :class:`HbmBudgetError` with per-buffer attribution when the
+        per-chip peak exceeds the budget, ``warn`` → one logger warning,
+        ``off``/no budget → report only."""
+        report = self.report()
+        if report["hbm_budget_verified"] is False:
+            msg = (
+                f"static HBM ledger: peak {report['peak_hbm_bytes_per_chip']} "
+                f"bytes/chip exceeds analysis.hbm_budget_bytes="
+                f"{report['hbm_budget_bytes']}\n" + self._attribution(report)
+            )
+            if self.mode == "raise":
+                raise HbmBudgetError(msg)
+            if logger is not None:
+                logger.warning(msg)
+        return report

@@ -98,6 +98,12 @@ def run_program_passes(
     stream_h2d = stream_d2h = stream_exposed = 0
     coll_ops: Dict[str, Dict[str, int]] = {}
     coll_bytes = coll_count = 0
+    # static HBM accounting: the per-chip peak is the largest single
+    # program (programs dispatch one at a time), replicated bytes likewise
+    memory_ok = True
+    memory_ran = False
+    peak_hbm = replicated = 0
+    undeclared_colls = 0
 
     for name in sorted(selected):
         fn = selected[name]
@@ -157,6 +163,17 @@ def run_program_passes(
                     stream_exposed += res.summary.get("exposed_stream_bytes", 0)
                     if not res.summary.get("stream_verified", False):
                         stream_ok = False
+            if pname == "memory":
+                memory_ran = True
+                if not res.ok:
+                    memory_ok = False
+                est = res.summary.get("estimate", {})
+                peak_hbm = max(peak_hbm, est.get("peak_hbm_bytes", 0))
+                shard = res.summary.get("sharding", {})
+                replicated = max(replicated, shard.get("replicated_bytes", 0))
+                undeclared_colls += len(
+                    shard.get("undeclared_collectives", ())
+                )
             if pname == "collectives":
                 for op, rec in res.summary.get("ops", {}).items():
                     agg = coll_ops.setdefault(op, {"count": 0, "bytes": 0})
@@ -187,6 +204,11 @@ def run_program_passes(
         "collective_count": coll_count,
         "collective_bytes": coll_bytes,
         "collectives": coll_ops,
+        # tri-state like the others: None unless the memory pass ran
+        "memory_verified": memory_ok if memory_ran else None,
+        "peak_hbm_bytes_per_chip": peak_hbm,
+        "replicated_bytes": replicated,
+        "undeclared_collectives": undeclared_colls,
     }
     return report
 
@@ -210,6 +232,8 @@ def engine_analysis_report(
         "min_donation_bytes": analysis_config.min_donation_bytes,
         "collective_budget_bytes": analysis_config.collective_budget_bytes,
         "stream_budget_bytes": getattr(analysis_config, "stream_budget_bytes", None),
+        "hbm_budget_bytes": getattr(analysis_config, "hbm_budget_bytes", None),
+        "hbm_budget": getattr(analysis_config, "hbm_budget", "raise"),
     }
     if extra_config:
         config.update(extra_config)

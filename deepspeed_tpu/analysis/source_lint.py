@@ -81,6 +81,22 @@ Rules (each one traces back to a real incident in PERF.md / PR history):
   ``rollback`` / ``free_slot`` / ``set_cache``; deliberate surgery (tests,
   checkpoint restore) carries a pragma.
 
+* **DS-R011 unsharded-pool-placement** — a ``device_put`` of a pool/param-
+  sized value (cache/pool/page/param/weight/master/kv/opt-state/buffer
+  names) on a mesh code path whose placement argument is not a sharding:
+  the PR-12 transient-OOM pattern — a full-size array committed to ONE
+  chip before any reshard, transiently costing tp× the steady-state
+  per-chip footprint on exactly the buffers sized against aggregate mesh
+  HBM. Allocate directly sharded (``jax.jit(..., out_shardings=...)``) or
+  place with a ``NamedSharding``; deliberate per-shard/host placements
+  carry a pragma.
+* **DS-R012 baked-constant-in-jit** (warn) — a module-level ndarray
+  constant (``np.array(...)`` / ``jnp.zeros(...)`` / ...) closed over by a
+  jitted function: the constant is baked into EVERY program that captures
+  it (per-program HBM copies the ledger never sees) and a rebind
+  silently retraces. Pass it as an argument (donated if large) or wrap
+  the jit so the constant hashes into the cache key deliberately.
+
 Suppression: append ``# lint: allow(DS-RXXX)`` (or ``# noqa: DS-RXXX``) to
 the offending line. Findings in ``tests/`` are always downgraded to
 warnings by the CLI — the gate is for the library.
@@ -105,8 +121,10 @@ RULES = {
     "DS-R008": "non-atomic persistence write (open 'w' without temp+rename) in a checkpoint/journal/bench path",
     "DS-R009": "raw clock / device_sync / unsanctioned host copy inside an engine/scheduler/streamer step-loop method (route through the tracer/timer or the stream helpers)",
     "DS-R010": "jax import in a host-only module (the fleet router / tracer must stay pure host code)",
+    "DS-R011": "device_put of a pool/param-sized value on a mesh path without a sharding (transient whole-buffer-on-one-chip OOM)",
+    "DS-R012": "module-level ndarray constant closed over by a jitted function (baked per-program HBM copy + silent-retrace hazard)",
 }
-_WARN_ONLY = {"DS-R003", "DS-R004"}
+_WARN_ONLY = {"DS-R003", "DS-R004", "DS-R012"}
 
 # DS-R010 scope: modules that must never import jax — the fleet router
 # keeps serving decisions alive while device backends wedge, and the
@@ -201,6 +219,25 @@ _R009_EXACT = {"time.time", "time.clock", "_sync"}
 _STREAMER_CLASS = re.compile(r"Streamer$")
 _STREAM_HELPER_FN = re.compile(r"^(__init__|_?set_master|_?(h2d|d2h|land|materialize|drain))")
 _STREAM_COPY_BASES = {"device_put", "device_get", "copy_to_host_async", "block_until_ready"}
+
+# DS-R011 scope: values sized like the buffers that OOM when transiently
+# committed whole to one chip, and the argument spellings that count as a
+# real sharding. "device" is deliberately NOT shard-ish — device_put(pool,
+# jax.devices()[0]) is exactly the PR-12 incident. A placement-less
+# device_put only flags on a mesh/shard/tp code path (enclosing-function
+# identifiers) — default-device placement of host data is fine elsewhere.
+_SIZEDISH = re.compile(
+    r"(cache|pool|page|param|weight|master|^kv$|kv_|_kv$|opt_state|buffer)",
+    re.IGNORECASE,
+)
+_SHARDISH = re.compile(r"(shard|spec|mesh|replicated)", re.IGNORECASE)
+_MESHY = re.compile(r"(mesh|shard|tp_|_tp$|^tp$)", re.IGNORECASE)
+
+# DS-R012 creators: module-level calls that build a host ndarray constant
+_CONST_MAKERS = re.compile(
+    r"^(np|numpy|jnp|onp|jax\.numpy)\.(array|asarray|ones|zeros|arange|full|"
+    r"linspace|eye)$"
+)
 
 _CACHEY = re.compile(
     r"(cache|page|pool|buffer|^kv$|^k$|^v$|^k_|^v_|_kv$|kv_)", re.IGNORECASE
@@ -672,6 +709,99 @@ def lint_source(src: str, path: str = "<string>") -> List[LintFinding]:
                     "device backend is wedged — keep them pure host code",
                 )
 
+    # ---- DS-R011: unsharded pool-sized placements ---------------------
+    def _scan_r011(node, fn_idents: Optional[Set[str]]):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # the enclosing function's identifier soup (its name, parameter
+            # names, and every identifier in the body) decides whether a
+            # placement-less device_put sits on a mesh path
+            fn_idents = _identifiers(node) | _fn_params(node) | {node.name}
+        if isinstance(node, ast.Call) and _dotted(node.func).rsplit(".", 1)[
+            -1
+        ] == "device_put":
+            arg_idents = _identifiers(node.args[0]) if node.args else set()
+            sized = sorted(i for i in arg_idents if _SIZEDISH.search(i))
+            placement = node.args[1] if len(node.args) >= 2 else None
+            if placement is None:
+                for kw in node.keywords:
+                    if kw.arg in ("device", "sharding", "shardings"):
+                        placement = kw.value
+            if sized:
+                if placement is None:
+                    if fn_idents is not None and any(
+                        _MESHY.search(i) for i in fn_idents
+                    ):
+                        add(
+                            node.lineno,
+                            "DS-R011",
+                            f"device_put of pool/param-sized value "
+                            f"({', '.join(sized[:3])}) with no sharding on a "
+                            "mesh path: the whole buffer transiently commits "
+                            "to one chip (tp x the per-chip footprint) — "
+                            "allocate directly sharded "
+                            "(jit(..., out_shardings=...)) or pass a "
+                            "NamedSharding",
+                        )
+                elif not any(_SHARDISH.search(i) for i in _identifiers(placement)):
+                    add(
+                        node.lineno,
+                        "DS-R011",
+                        f"device_put of pool/param-sized value "
+                        f"({', '.join(sized[:3])}) onto a non-sharding "
+                        "placement: the whole buffer lands on one chip before "
+                        "any reshard (the PR-12 transient OOM) — place with a "
+                        "NamedSharding or allocate via out_shardings",
+                    )
+        for child in ast.iter_child_nodes(node):
+            _scan_r011(child, fn_idents)
+
+    _scan_r011(tree, None)
+
+    # ---- DS-R012: module-level ndarray constants captured by jit ------
+    const_lines: Dict[str, int] = {}
+    for stmt in tree.body:  # module level only: the bake-forever captures
+        if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+            if _CONST_MAKERS.match(_dotted(stmt.value.func)):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        const_lines[t.id] = stmt.lineno
+    if const_lines:
+        seen_r012: Set[int] = set()
+        for body in jit_bodies:
+            if id(body) in seen_r012:
+                continue
+            seen_r012.add(id(body))
+            local: Set[str] = set(_fn_params(body))
+            for n in ast.walk(body):
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                    local |= _fn_params(n)
+                elif isinstance(n, (ast.Assign, ast.AugAssign)):
+                    targets = (
+                        [n.target] if isinstance(n, ast.AugAssign) else n.targets
+                    )
+                    for t in targets:
+                        if isinstance(t, ast.Name):
+                            local.add(t.id)
+            flagged: Set[str] = set()
+            for n in ast.walk(body):
+                if (
+                    isinstance(n, ast.Name)
+                    and isinstance(n.ctx, ast.Load)
+                    and n.id in const_lines
+                    and n.id not in local
+                    and n.id not in flagged
+                ):
+                    flagged.add(n.id)
+                    add(
+                        n.lineno,
+                        "DS-R012",
+                        f"jitted function closes over module-level ndarray "
+                        f"constant {n.id!r} (defined line "
+                        f"{const_lines[n.id]}): the array is baked into every "
+                        "capturing program (untracked per-program HBM) and a "
+                        "rebind silently retraces — pass it as an argument",
+                    )
+
     # ---- DS-R004: jit call sites without donation ---------------------
     for call in collector.jit_calls:
         kwnames = {kw.arg for kw in call.keywords if kw.arg}
@@ -740,14 +870,34 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("paths", nargs="*", default=["deepspeed_tpu", "tests"])
     ap.add_argument("--format", choices=("text", "json"), default="text")
     ap.add_argument(
+        "--json",
+        action="store_true",
+        help="shorthand for --format json (structured output for CI gates)",
+    )
+    ap.add_argument(
+        "--rule",
+        action="append",
+        default=None,
+        metavar="DS-RXXX",
+        help="only report findings of these rule id(s); repeatable",
+    )
+    ap.add_argument(
         "--warn-prefix",
         action="append",
         default=None,
         help="path prefixes whose findings are warn-only (default: tests)",
     )
     ns = ap.parse_args(argv)
+    if ns.json:
+        ns.format = "json"
     warn_prefixes = ns.warn_prefix if ns.warn_prefix else ["tests"]
     findings = lint_paths(ns.paths)
+    if ns.rule:
+        wanted = set(ns.rule)
+        unknown = wanted - set(RULES) - {"DS-R000"}
+        if unknown:
+            ap.error(f"unknown rule id(s): {', '.join(sorted(unknown))}")
+        findings = [f for f in findings if f.rule in wanted]
     n_err = 0
     for f in findings:
         f.severity = resolve_severity(f, warn_prefixes)

@@ -83,6 +83,11 @@ class InferenceEngine:
         self._obs_hub.add_source("compile", self.compile_stats)
         self._obs_hub.add_source("analysis", self.analysis_report)
         self._obs_hub.add_source("serve", self.serve_stats)
+        # enforce=False: an over-budget ledger surfaces IN the snapshot
+        # rather than failing the observability read
+        self._obs_hub.add_source(
+            "memory", lambda: self.memory_report(enforce=False)
+        )
         if tcfg.flight_recorder:
             self._obs_hub.install_flight_recorder(
                 dump_dir=tcfg.flight_recorder_dir,
@@ -529,14 +534,98 @@ class InferenceEngine:
         from deepspeed_tpu.analysis import engine_analysis_report
 
         return engine_analysis_report(
-            self._telemetry, self._config.analysis, programs=programs, passes=passes
+            self._telemetry,
+            self._config.analysis,
+            programs=programs,
+            passes=passes,
+            extra_config=self._analysis_extra_config(),
         )
+
+    def _analysis_extra_config(self):
+        """Engine-declared analysis-pass inputs: with tensor-parallel
+        serving armed, the TP context's declared comm schedule and sharding
+        rules let the memory pass flag pjit-inserted resharding collectives
+        and large weights left replicated against the layout contract."""
+        srv = getattr(self._paged_server, "server", self._paged_server)
+        tp = getattr(srv, "tp", None)
+        if tp is not None and tp.degree > 1:
+            return {
+                "declared_collectives": tp.declared_collectives(),
+                "sharding_rules": tp.sharding_rules(),
+            }
+        return None
 
     def _verify_program_static(self, name: str) -> None:
         from deepspeed_tpu.analysis import verify_program
         from deepspeed_tpu.utils.logging import logger
 
-        verify_program(self._telemetry, self._config.analysis, name, logger=logger)
+        verify_program(
+            self._telemetry,
+            self._config.analysis,
+            name,
+            logger=logger,
+            extra_config=self._analysis_extra_config(),
+        )
+
+    def memory_report(self, include_programs: bool = False, enforce: bool = True):
+        """Static per-chip HBM residency ledger for the inference engine:
+        the dense-path param tree, the (possibly resharded / int8) serving
+        weights, and the paged KV pool — per-chip bytes under each leaf's
+        sharding, with the pool's host-side page tables accounted as host
+        RAM (the tp serving contract: KV bytes/chip == total/tp, tables
+        never on device). ``include_programs=True`` folds in per-program
+        transient estimates from the analysis memory pass (one re-trace
+        each). ``enforce=True`` applies ``analysis.hbm_budget_bytes`` —
+        over budget raises ``HbmBudgetError`` with per-buffer attribution
+        (or warns, per ``analysis.hbm_budget``)."""
+        from deepspeed_tpu.analysis import MemoryLedger
+        from deepspeed_tpu.utils.logging import logger
+
+        acfg = self._config.analysis
+        ledger = MemoryLedger(
+            hbm_budget_bytes=getattr(acfg, "hbm_budget_bytes", None),
+            mode=getattr(acfg, "hbm_budget", "raise"),
+        )
+        if self._params is not None:
+            ledger.add_tree("params", self._params, kind="params")
+        srv = getattr(self._paged_server, "server", self._paged_server)
+        if srv is not None:
+            sp = getattr(srv, "params", None)
+            if sp is not None and sp is not self._params:
+                ledger.add_tree("serving_params", sp, kind="params")
+            pool = getattr(srv, "pool", None)
+            if pool is not None:
+                rep = pool.memory_report()
+                ledger.add_persistent(
+                    "kv_pages",
+                    per_chip_bytes=rep["kv_bytes_per_chip"],
+                    global_bytes=rep["kv_total_bytes"],
+                    kind="kv_pool",
+                    detail=rep,
+                )
+                ledger.add_persistent(
+                    "kv_page_tables",
+                    per_chip_bytes=rep["host_table_bytes"],
+                    location="host",
+                    kind="kv_pool",
+                )
+        if include_programs:
+            try:
+                rep = self.analysis_report(passes=["memory"])
+                for pname, entry in rep.get("programs", {}).items():
+                    est = (
+                        entry.get("passes", {})
+                        .get("memory", {})
+                        .get("summary", {})
+                        .get("estimate")
+                    )
+                    if est:
+                        ledger.add_program(pname, est)
+            except Exception as e:  # analysis failure ≠ ledger failure
+                logger.warning(f"memory ledger: program estimates failed: {e}")
+        if enforce:
+            return ledger.enforce(logger=logger)
+        return ledger.report()
 
     def _build_paged_server(self):
         from deepspeed_tpu.inference.scheduler import PagedServer

@@ -258,6 +258,42 @@ class PagePool:
         """HBM actually pinned by live sequences (page-granular)."""
         return self.used_pages() * self.page_size * self.cache.bytes_per_token
 
+    def memory_report(self) -> dict:
+        """Static residency accounting for the analysis HBM ledger: total
+        device bytes of the page pools, the per-chip share under the pool's
+        kv-head sharding (``total / tp`` when sharded — the tensor-parallel
+        serving contract), and the host-side scheduling structures (page
+        table, sequence lengths, refcounts, ownership) that stay replicated
+        host RAM, never HBM."""
+        total = self.cache.hbm_bytes()
+        per_chip = total
+        devices = 1
+        if self.kv_sharding is not None:
+            try:
+                devices = int(self.kv_sharding.num_devices)
+                shard = self.kv_sharding.shard_shape(
+                    tuple(self.cache.k_pages.shape)
+                )
+                n = 1
+                for d in shard:
+                    n *= int(d)
+                per_chip = 2 * n * self.cache.k_pages.dtype.itemsize
+            except Exception:
+                per_chip = total
+        return {
+            "kv_total_bytes": total,
+            "kv_bytes_per_chip": per_chip,
+            "kv_devices": devices,
+            "live_kv_bytes": self.live_hbm_bytes(),
+            "page_table_location": "host",
+            "host_table_bytes": int(
+                self.page_table.nbytes
+                + self.seq_lens.nbytes
+                + self._refcount.nbytes
+                + self._owned.nbytes
+            ),
+        }
+
     def utilization(self) -> float:
         """Live tokens over allocated page capacity (1.0 = no page waste;
         prefix sharing can push it past 1.0 — N sequences reading one

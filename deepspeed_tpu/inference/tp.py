@@ -252,6 +252,43 @@ class TPServing:
         )
         return jax.device_put(params, shardings)
 
+    # --- declared comm/sharding contract (analysis memory pass) ----------
+    def declared_collectives(self):
+        """The collective op kinds the serving programs INTENTIONALLY
+        contain, for the sharding auditor's undeclared-reshard check: the
+        row-parallel fp path all-reduces partial sums; the quantized
+        exchange swaps that for all-to-all + all-gather (psum fallback when
+        a projection's last dim does not split); the vocab-sharded argmax
+        all-gathers its (max, index) pairs. Anything else in a compiled
+        serving module is a pjit-inserted reshard the engine never
+        planned."""
+        if self.degree == 1:
+            return []
+        ops = {"all-reduce"}
+        if self.quantized_allreduce:
+            ops |= {"all-to-all", "all-gather"}
+        if self.head_sharded:
+            ops.add("all-gather")
+        return sorted(ops)
+
+    def sharding_rules(self, min_bytes: int = 1 << 16):
+        """Declared "these leaves shard" rules for the auditor: every
+        column/row-parallel weight name (dict-key path match) plus the
+        rank-5 ``[L, NP, NKV, P, D]`` page pools, which enter the serving
+        programs positionally. A matching leaf ≥ ``min_bytes`` found fully
+        replicated on the mesh is a red finding — per-chip HBM is paying
+        the whole buffer the layout promised to split."""
+        if self.degree == 1:
+            return []
+        names = set(_COLUMN) | set(_ROW)
+        if self.head_sharded:
+            names.add("lm_head")
+        pattern = "|".join(sorted(names))
+        return [
+            {"pattern": f"\\['({pattern})'\\]", "min_bytes": int(min_bytes)},
+            {"rank": 5, "pattern": "", "min_bytes": int(min_bytes)},
+        ]
+
     # --- trace-time pieces (used inside the shard_map body) --------------
     def reduce(self, x):
         """Sum row-parallel partials across the tp axis (fp psum, or the

@@ -212,12 +212,28 @@ class AnalysisConfig(DeepSpeedConfigModel):
     # H2D+D2H stream bytes (overlap pass stream-accounting mode). None = no
     # budget; any declared traffic above it is an error-severity violation.
     stream_budget_bytes: Optional[int] = None
+    # Static HBM gate: per-chip byte budget for the residency ledger
+    # (``engine.memory_report()``) AND the memory pass's per-program peak
+    # estimate. None = report-only. ``hbm_budget`` picks the reaction like
+    # ``verify``: "raise" (default) fails with per-buffer attribution,
+    # "warn" logs it, "off" disables the gate but keeps the ledger.
+    hbm_budget_bytes: Optional[int] = None
+    hbm_budget: str = "raise"  # off | warn | raise
 
     @field_validator("verify")
     @classmethod
     def _check_verify(cls, v):
         if v not in ("off", "warn", "raise"):
             raise ValueError(f"analysis.verify must be off|warn|raise, got {v!r}")
+        return v
+
+    @field_validator("hbm_budget")
+    @classmethod
+    def _check_hbm_budget(cls, v):
+        if v not in ("off", "warn", "raise"):
+            raise ValueError(
+                f"analysis.hbm_budget must be off|warn|raise, got {v!r}"
+            )
         return v
 
 

@@ -261,6 +261,11 @@ class DeepSpeedEngine:
         self._obs_hub.add_source("compile", self.compile_stats)
         self._obs_hub.add_source("analysis", self.analysis_report)
         self._obs_hub.add_source("checkpoint", self.checkpoint_stats)
+        # enforce=False: an over-budget ledger must surface IN the snapshot,
+        # not blow up the whole observability read
+        self._obs_hub.add_source(
+            "memory", lambda: self.memory_report(enforce=False)
+        )
         if tcfg.flight_recorder:
             self._obs_hub.install_flight_recorder(
                 dump_dir=tcfg.flight_recorder_dir,
@@ -2529,6 +2534,95 @@ class DeepSpeedEngine:
             logger=logger,
             extra_config=self._analysis_extra_config(),
         )
+
+    def memory_report(
+        self, include_programs: bool = False, enforce: bool = True
+    ) -> Dict[str, Any]:
+        """Static per-chip HBM residency ledger over the engine's live
+        persistent state: compute params, fp32 master (skipped when it IS
+        the param tree), optimizer state, gradient-accumulation buffers,
+        loss-scale state — each with global/per-chip/replicated byte
+        accounting from its sharding — plus, on the offload paths, the
+        host-resident master/moments and the streamed path's ≤ 2-bucket
+        device staging bound. ``include_programs=True`` folds in the
+        per-program transient peak estimates from the analysis memory pass
+        (re-traces each program once). ``enforce=True`` (the default for
+        direct calls) applies ``analysis.hbm_budget_bytes``: over budget
+        raises :class:`~deepspeed_tpu.analysis.HbmBudgetError` (or warns,
+        per ``analysis.hbm_budget``) with per-buffer attribution; the
+        observability hub reads with ``enforce=False``."""
+        from deepspeed_tpu.analysis import MemoryLedger
+
+        acfg = self._config.analysis_config
+        ledger = MemoryLedger(
+            hbm_budget_bytes=acfg.hbm_budget_bytes, mode=acfg.hbm_budget
+        )
+        if self._params is not None:
+            ledger.add_tree("params", self._params, kind="params")
+        if self._master is not None and self._master is not self._params:
+            ledger.add_tree("master", self._master, kind="optimizer")
+        if self._opt_state is not None:
+            ledger.add_tree("opt_state", self._opt_state, kind="optimizer")
+        if self._grad_acc is not None:
+            ledger.add_tree("grad_acc", self._grad_acc, kind="grads")
+        if self._scale_state is not None:
+            ledger.add_tree("scale_state", self._scale_state, kind="scaler")
+        ho = self._host_offload
+        if ho is not None and self._streamed_offload:
+            rep = ho.memory_report()
+            ledger.add_persistent(
+                "offload_host_state",
+                per_chip_bytes=rep["host_bytes"],
+                location="host",
+                kind="optimizer",
+                detail=rep,
+            )
+            # the streamed path's whole device-side optimizer footprint:
+            # the static ≤ 2-bucket staging bound, NOT the model-sized state
+            ledger.add_persistent(
+                "offload_device_buckets",
+                per_chip_bytes=rep["device_residency_bound_bytes"],
+                kind="offload_buckets",
+                detail={
+                    "buckets": rep["buckets"],
+                    "max_bucket_bytes": rep["max_bucket_bytes"],
+                    "staged_bytes": rep["staged_bytes"],
+                    "pending_bytes": rep["pending_bytes"],
+                },
+            )
+        elif ho is not None:
+            # legacy ZeRO-Offload (host AVX Adam): master + moments in DRAM
+            try:
+                host = 3 * sum(
+                    int(sh.master.nbytes)
+                    for shards in ho._shards
+                    for sh in shards
+                )
+            except Exception:
+                host = 0
+            ledger.add_persistent(
+                "offload_host_state",
+                per_chip_bytes=host,
+                location="host",
+                kind="optimizer",
+            )
+        if include_programs:
+            try:
+                rep = self.analysis_report(passes=["memory"])
+                for pname, entry in rep.get("programs", {}).items():
+                    est = (
+                        entry.get("passes", {})
+                        .get("memory", {})
+                        .get("summary", {})
+                        .get("estimate")
+                    )
+                    if est:
+                        ledger.add_program(pname, est)
+            except Exception as e:  # analysis failure ≠ ledger failure
+                logger.warning(f"memory ledger: program estimates failed: {e}")
+        if enforce:
+            return ledger.enforce(logger=logger)
+        return ledger.report()
 
     def train_batch(self, data_iter=None, batch=None):
         """Convenience: run a full GAS cycle — gas × fwd/bwd + step, or,

@@ -344,6 +344,37 @@ class HostOffloadStreamer:
         out["pending_writes"] = len(self._pending)
         return out
 
+    # -- static residency accounting (analysis memory ledger) -----------
+    def memory_report(self) -> Dict[str, Any]:
+        """Byte-level residency contract for the HBM ledger: the master +
+        both moments live in HOST RAM; the device only ever holds the
+        staged upload of the bucket about to update plus the in-flight
+        writeback of the bucket that just did — a ≤ 2-bucket bound,
+        independent of model size. ``device_residency_bound_bytes`` is that
+        static bound (the two largest buckets at the full 12-bytes/elem
+        writeback footprint); ``staged_bytes``/``pending_bytes`` are the
+        actual bytes on device right now."""
+        per_elem_staged = 12 if self.mixed_precision else 8
+        bucket_bytes = [
+            self._bucket_elems(bi) * 12 for bi in range(self.num_buckets)
+        ]
+        bound = sum(sorted(bucket_bytes, reverse=True)[:2])
+        staged = sum(
+            self._bucket_elems(bi) * per_elem_staged for bi in self._staged
+        )
+        pending = sum(self._bucket_elems(p[0]) * 12 for p in self._pending)
+        return {
+            "master_location": "host",
+            "host_bytes": 3 * sum(m.nbytes for m in self._master),
+            "buckets": self.num_buckets,
+            "bucket_bytes": bucket_bytes,
+            "max_bucket_bytes": max(bucket_bytes, default=0),
+            "device_residency_bound_bytes": bound,
+            "staged_bytes": staged,
+            "pending_bytes": pending,
+            "device_bytes": staged + pending,
+        }
+
     def note_step(self) -> None:
         self._stats["steps"] += 1
 

@@ -1,0 +1,300 @@
+"""Red/green fixtures for the static HBM layer (ISSUE 18): the per-program
+peak estimator (backend stats + HLO-walk fallback), the sharding auditor
+(replicated-leaf and undeclared-collective findings), the ``memory``
+program pass's budget gate, and the whole-run :class:`MemoryLedger` behind
+``engine.memory_report()`` / ``analysis.hbm_budget_bytes``.
+"""
+
+from __future__ import annotations
+
+import logging
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.analysis import (
+    HbmBudgetError,
+    MemoryLedger,
+    ProgramArtifact,
+    analyze_program,
+    audit_sharding,
+    estimate_program_memory,
+    run_program_passes,
+    tree_device_bytes,
+)
+from deepspeed_tpu.profiling.compile_telemetry import CompileTelemetry
+
+
+def _dispatch(tel, name, fn, *args, **jit_kwargs):
+    wrapped = tel.instrument(name, fn, **jit_kwargs)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        wrapped(*args)
+    return wrapped
+
+
+def _art(tel, name) -> ProgramArtifact:
+    return ProgramArtifact(name, tel.programs()[name])
+
+
+class _NoBackendStats:
+    """An artifact view whose executable refuses ``memory_analysis()`` —
+    forces the estimator down the optimized-HLO buffer walk."""
+
+    def __init__(self, art: ProgramArtifact):
+        self.name = art.name
+        self.hlo_text = art.hlo_text
+        self._wrapper = art._wrapper
+
+    @property
+    def compiled(self):
+        raise RuntimeError("backend provides no buffer-assignment stats")
+
+
+# ---------------------------------------------------------------------------
+# per-program estimator
+# ---------------------------------------------------------------------------
+def test_estimator_accounts_argument_and_output_bytes():
+    """peak = args + out + temp − alias, and the argument side must cover
+    the real input buffers (one 128×128 f32 = 64 KiB here)."""
+    tel = CompileTelemetry()
+
+    def f(x):
+        return x * 2.0
+
+    _dispatch(tel, "mul", f, jnp.ones((128, 128), jnp.float32))
+    est = estimate_program_memory(_art(tel, "mul"))
+    assert est["source"] in ("memory_analysis", "hlo_walk")
+    assert est["argument_bytes"] >= 128 * 128 * 4
+    assert est["output_bytes"] >= 128 * 128 * 4
+    assert est["peak_hbm_bytes"] == max(
+        est["argument_bytes"]
+        + est["output_bytes"]
+        + est["temp_bytes"]
+        - est["alias_bytes"],
+        0,
+    )
+
+
+def test_estimator_hlo_walk_fallback_matches_buffers():
+    """With backend stats unavailable the HLO walk must reconstruct the
+    same argument/output accounting from the ENTRY computation."""
+    tel = CompileTelemetry()
+
+    def f(x, y):
+        return x + y.sum()
+
+    _dispatch(
+        tel, "walk", f, jnp.ones((64, 64), jnp.float32), jnp.ones((32,), jnp.float32)
+    )
+    est = estimate_program_memory(_NoBackendStats(_art(tel, "walk")))
+    assert est["source"] == "hlo_walk"
+    assert est["argument_bytes"] == 64 * 64 * 4 + 32 * 4
+    assert est["output_bytes"] >= 64 * 64 * 4
+    assert est["temp_bytes"] == 0  # unknowable from text: lower bound
+
+
+def test_estimator_hlo_walk_dedups_donation_alias():
+    """A donated-and-honored input must be subtracted once via the
+    input_output_alias table (when the backend honors the donation the
+    walk's alias bytes cover the reused parameter)."""
+    tel = CompileTelemetry()
+
+    def f(big, x):
+        return big + 1.0, x * 2.0
+
+    _dispatch(
+        tel,
+        "don",
+        f,
+        jnp.ones((256, 256), jnp.float32),
+        jnp.ones((8,), jnp.float32),
+        donate_argnums=(0,),
+    )
+    art = _art(tel, "don")
+    est = estimate_program_memory(_NoBackendStats(art))
+    from deepspeed_tpu.analysis.hlo import parse_input_output_aliases
+
+    aliased = parse_input_output_aliases(art.hlo_text)
+    if aliased:  # CPU may decline the alias; when honored, it must dedup
+        assert est["alias_bytes"] >= 256 * 256 * 4
+        assert est["peak_hbm_bytes"] < est["argument_bytes"] + est["output_bytes"]
+    else:
+        assert est["alias_bytes"] == 0
+
+
+# ---------------------------------------------------------------------------
+# sharding auditor
+# ---------------------------------------------------------------------------
+def test_audit_red_replicated_leaf_against_rule(eight_devices):
+    """A large leaf left fully replicated on a 4-chip mesh when a declared
+    sharding rule matches it must be an error finding; the properly
+    sharded leaf must not."""
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ("model",))
+    sharded = jax.device_put(
+        jnp.zeros((64, 128), jnp.float32), NamedSharding(mesh, P(None, "model"))
+    )
+    replicated = jax.device_put(
+        jnp.zeros((64, 128), jnp.float32), NamedSharding(mesh, P(None, None))
+    )
+    tel = CompileTelemetry()
+
+    def f(a, b):
+        return a.sum() + b.sum()
+
+    _dispatch(tel, "aud", f, sharded, replicated)
+    summary, violations = audit_sharding(
+        _art(tel, "aud"), rules=[{"pattern": "", "min_bytes": 1024}]
+    )
+    assert summary["mesh_devices"] == 4
+    assert summary["replicated_bytes"] == 64 * 128 * 4
+    assert summary["sharded_bytes"] == 64 * 128 * 4 // 4
+    assert len(violations) == 1, [v.message for v in violations]
+    assert "replicated" in violations[0].message
+
+
+def test_audit_green_no_rules_is_summary_only(eight_devices):
+    """No declared rules/schedule → the auditor summarizes, flags nothing
+    (the default-config contract the green sweeps rely on)."""
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ("model",))
+    replicated = jax.device_put(
+        jnp.zeros((64, 128), jnp.float32), NamedSharding(mesh, P(None, None))
+    )
+    tel = CompileTelemetry()
+    _dispatch(tel, "quiet", lambda a: a.sum(), replicated)
+    summary, violations = audit_sharding(_art(tel, "quiet"))
+    assert violations == []
+    assert "undeclared_collectives" in summary
+
+
+def test_audit_undeclared_collective_red_and_green(eight_devices):
+    """A cross-chip reduction the declared comm schedule does not contain
+    is a red finding; declaring it clears the same program."""
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ("model",))
+    x = jax.device_put(
+        jnp.ones((64, 8), jnp.float32), NamedSharding(mesh, P("model", None))
+    )
+    tel = CompileTelemetry()
+
+    def f(x):
+        return x - jnp.mean(x)  # mean over the sharded axis → all-reduce
+
+    _dispatch(tel, "reshard", f, x)
+    art = _art(tel, "reshard")
+    _, red = audit_sharding(art, declared_collectives=[])
+    assert red, "pjit-inserted collective not flagged against an empty schedule"
+    assert any("all-reduce" in v.message for v in red)
+    _, green = audit_sharding(art, declared_collectives=["all-reduce"])
+    assert green == []
+
+
+# ---------------------------------------------------------------------------
+# the memory pass + budget gate
+# ---------------------------------------------------------------------------
+def test_memory_pass_default_config_summary_only():
+    tel = CompileTelemetry()
+    _dispatch(tel, "plain", lambda x: x + 1.0, jnp.ones((32, 32)))
+    res = analyze_program("plain", tel.programs()["plain"], passes=["memory"])[
+        "memory"
+    ]
+    assert res.ok and not res.violations
+    assert res.summary["estimate"]["peak_hbm_bytes"] > 0
+
+
+def test_memory_pass_budget_red_green():
+    tel = CompileTelemetry()
+    _dispatch(tel, "budget", lambda x: x * 3.0, jnp.ones((64, 64), jnp.float32))
+    fn = tel.programs()["budget"]
+    red = analyze_program(
+        "budget", fn, passes=["memory"], config={"hbm_budget_bytes": 16}
+    )["memory"]
+    assert not red.ok
+    assert "exceeds analysis.hbm_budget_bytes=16" in red.violations[0].message
+    off = analyze_program(
+        "budget",
+        fn,
+        passes=["memory"],
+        config={"hbm_budget_bytes": 16, "hbm_budget": "off"},
+    )["memory"]
+    assert off.ok
+    green = analyze_program(
+        "budget", fn, passes=["memory"], config={"hbm_budget_bytes": 1 << 30}
+    )["memory"]
+    assert green.ok
+
+
+def test_report_totals_aggregate_memory(eight_devices):
+    """run_program_passes totals must carry the memory tri-state + the
+    per-chip peak / replicated-bytes aggregates the bench records read."""
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ("model",))
+    replicated = jax.device_put(
+        jnp.zeros((32, 64), jnp.float32), NamedSharding(mesh, P(None, None))
+    )
+    tel = CompileTelemetry()
+    _dispatch(tel, "tot", lambda a: a * 2.0, replicated)
+    rep = run_program_passes(tel, passes=["memory"])
+    t = rep["totals"]
+    assert t["memory_verified"] is True
+    assert t["peak_hbm_bytes_per_chip"] > 0
+    assert t["replicated_bytes"] == 32 * 64 * 4
+    assert t["undeclared_collectives"] == 0
+    # a report that never ran the memory pass must stay tri-state None
+    rep2 = run_program_passes(tel, passes=["donation"])
+    assert rep2["totals"]["memory_verified"] is None
+
+
+# ---------------------------------------------------------------------------
+# the residency ledger
+# ---------------------------------------------------------------------------
+def test_ledger_peak_model_and_attribution():
+    led = MemoryLedger(hbm_budget_bytes=1000, mode="raise")
+    led.add_persistent("params", per_chip_bytes=600, kind="params")
+    led.add_persistent("opt_host", per_chip_bytes=5000, location="host")
+    led.add_program(
+        "step",
+        {"argument_bytes": 600, "output_bytes": 700, "alias_bytes": 600, "temp_bytes": 50},
+    )
+    rep = led.report()
+    # host bytes never count toward the device peak
+    assert rep["peak_hbm_bytes_per_chip"] == 600 + (50 + 100)
+    assert rep["host_bytes"] == 5000
+    assert rep["hbm_budget_verified"] is True
+    led.hbm_budget_bytes = 700
+    with pytest.raises(HbmBudgetError) as ei:
+        led.enforce()
+    msg = str(ei.value)
+    assert "params" in msg and "600" in msg  # per-buffer attribution
+    assert "step" in msg  # transient attribution
+
+
+def test_ledger_warn_and_off_modes(caplog):
+    led = MemoryLedger(hbm_budget_bytes=10, mode="warn")
+    led.add_persistent("big", per_chip_bytes=100)
+    log = logging.getLogger("test_ledger_warn")
+    with caplog.at_level(logging.WARNING, logger="test_ledger_warn"):
+        rep = led.enforce(logger=log)  # must not raise
+    assert rep["hbm_budget_verified"] is False
+    assert any("exceeds" in r.message for r in caplog.records)
+    led.mode = "off"
+    rep = led.enforce()
+    assert rep["hbm_budget_verified"] is None
+
+
+def test_tree_device_bytes_sharded_vs_replicated(eight_devices):
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ("model",))
+    tree = {
+        "w": jax.device_put(
+            jnp.zeros((16, 64), jnp.float32), NamedSharding(mesh, P(None, "model"))
+        ),
+        "b": jax.device_put(
+            jnp.zeros((64,), jnp.float32), NamedSharding(mesh, P(None))
+        ),
+    }
+    acct = tree_device_bytes(tree)
+    assert acct["global_bytes"] == 16 * 64 * 4 + 64 * 4
+    assert acct["per_chip_bytes"] == 16 * 64 * 4 // 4 + 64 * 4
+    assert acct["replicated_bytes"] == 64 * 4

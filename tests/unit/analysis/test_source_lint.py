@@ -706,3 +706,160 @@ def test_library_lints_clean():
         if resolve_severity(f) == "error"
     ]
     assert not errors, "\n".join(errors)
+
+
+def test_r011_device_put_onto_device_flagged():
+    """The PR-12 incident shape: a pool-sized buffer device_put onto a
+    bare device — the whole pool transiently commits to one chip."""
+    assert "DS-R011" in _rules("""
+        import jax, jax.numpy as jnp
+        def place_pool(kv_pages):
+            return jax.device_put(kv_pages, jax.devices()[0])
+    """)
+
+
+def test_r011_sharded_placement_ok():
+    """Placing with a NamedSharding / spec tree is the sanctioned fix."""
+    assert "DS-R011" not in _rules("""
+        import jax
+        def shard(params, shardings):
+            return jax.device_put(params, shardings)
+    """)
+    assert "DS-R011" not in _rules("""
+        import jax
+        def shard(params, mesh, spec):
+            from jax.sharding import NamedSharding
+            return jax.device_put(params, NamedSharding(mesh, spec))
+    """)
+
+
+def test_r011_placementless_only_on_mesh_path():
+    """A bare device_put of a sized value only flags inside mesh/shard
+    code — default-device placement of host data is fine elsewhere."""
+    assert "DS-R011" in _rules("""
+        import jax
+        def build_on_mesh(cache, mesh):
+            return jax.device_put(cache)
+    """)
+    assert "DS-R011" not in _rules("""
+        import jax
+        def stage(cache):
+            return jax.device_put(cache)
+    """)
+
+
+def test_r011_unsized_values_ok():
+    assert "DS-R011" not in _rules("""
+        import jax
+        def f(x, mesh):
+            return jax.device_put(x, jax.devices()[0])
+    """)
+
+
+def test_r011_pragma_suppresses_and_is_error_severity():
+    findings = lint_source(
+        textwrap.dedent("""
+        import jax
+        def per_shard(master, dev):
+            return jax.device_put(master, dev)  # lint: allow(DS-R011)
+    """),
+        path="deepspeed_tpu/foo.py",
+    )
+    assert "DS-R011" not in [f.rule for f in findings]
+    bad = lint_source(
+        textwrap.dedent("""
+        import jax
+        def per_shard(master, dev):
+            return jax.device_put(master, dev)
+    """),
+        path="deepspeed_tpu/foo.py",
+    )
+    hit = [f for f in bad if f.rule == "DS-R011"]
+    assert hit and resolve_severity(hit[0]) == "error"
+
+
+def test_r012_module_constant_in_jit_flagged():
+    rules = _rules("""
+        import jax, numpy as np
+        TABLE = np.arange(1024.0)
+        @jax.jit
+        def f(x):
+            return x + TABLE
+    """)
+    assert "DS-R012" in rules
+
+
+def test_r012_constant_passed_as_argument_ok():
+    assert "DS-R012" not in _rules("""
+        import jax, numpy as np
+        TABLE = np.arange(1024.0)
+        @jax.jit
+        def f(x, table):
+            return x + table
+        def call(x):
+            return f(x, TABLE)  # capture-free: rides the arg path
+    """)
+
+
+def test_r012_local_shadow_ok():
+    assert "DS-R012" not in _rules("""
+        import jax, numpy as np
+        TABLE = np.arange(4.0)
+        @jax.jit
+        def f(x):
+            TABLE = x * 2
+            return x + TABLE
+    """)
+
+
+def test_r012_is_warn_only():
+    f = [
+        x
+        for x in lint_source(
+            textwrap.dedent("""
+        import jax, numpy as np
+        C = np.zeros(8)
+        @jax.jit
+        def f(x):
+            return x + C
+    """),
+            path="deepspeed_tpu/foo.py",
+        )
+        if x.rule == "DS-R012"
+    ]
+    assert f and resolve_severity(f[0]) == "warn"
+
+
+def test_cli_json_and_rule_filter(tmp_path, capsys):
+    """--json emits machine-readable findings and --rule narrows to the
+    named rule ids (the structured interface the CI gates assert on)."""
+    import json
+
+    from deepspeed_tpu.analysis.source_lint import main
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        textwrap.dedent("""
+        import jax, jax.numpy as jnp
+        def place(kv_pages, k_cache, G):
+            jnp.repeat(k_cache, G)
+            return jax.device_put(kv_pages, jax.devices()[0])
+    """)
+    )
+    rc = main([str(bad), "--json", "--rule", "DS-R011"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert [f["rule"] for f in out] == ["DS-R011"]
+    rc = main([str(bad), "--json", "--rule", "DS-R001"])
+    out = json.loads(capsys.readouterr().out)
+    assert [f["rule"] for f in out] == ["DS-R001"]
+    assert rc == 1
+
+
+def test_cli_rule_filter_rejects_unknown(tmp_path):
+    import pytest
+
+    from deepspeed_tpu.analysis.source_lint import main
+
+    with pytest.raises(SystemExit):
+        main([str(tmp_path), "--rule", "DS-R999"])

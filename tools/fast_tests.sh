@@ -108,6 +108,19 @@
 # train.mid_offload_stream chaos kill → auto_resume bit-identical,
 # legacy cpu_offload* config-routing red tests, bench bisection-probe
 # unit.
+# +static HBM ledger 2026-08-07 (test_memory.py + test_passes.py::
+# test_green_memory_ledger_{offload,tp_serving} ride the lint.sh analysis
+# suite; DS-R011/DS-R012 lint + the --json/--rule CLI ride
+# test_source_lint.py): per-program peak-HBM estimator (backend
+# memory_analysis() + optimized-HLO walk fallback with donation-alias
+# dedup), sharding auditor (replicated-leaf-vs-declared-rule +
+# pjit-inserted-collective-vs-declared-schedule red/green), whole-run
+# residency ledger behind engine.memory_report() gated by
+# analysis.hbm_budget_bytes (off|warn|raise, over-budget raises with
+# per-buffer attribution). The two green gates statically reproduce the
+# runtime claims: streamed zero-3 offload holds ≤2 buckets on device with
+# the fp32 master host-side, and tp=4 serving holds KV bytes/chip ==
+# total/tp with page tables host-side + 0 undeclared reshard collectives.
 cd "$(dirname "$0")/.." || exit 1
 sh tools/lint.sh || exit 1
 exec python -m pytest -q \

@@ -5,10 +5,12 @@ Usage::
 
     python tools/lint.py                      # lint deepspeed_tpu + tests
     python tools/lint.py deepspeed_tpu bench.py --format json
+    python tools/lint.py --json               # shorthand for --format json
+    python tools/lint.py --rule DS-R011       # only the named rule(s)
 
-Rules (DS-R001 repeat-on-cache, DS-R002 host-sync-in-jit, DS-R003
-shape-branch-in-jit, DS-R004 jit-missing-donation) are documented in the
-module and README ("Static analysis"). Findings under ``tests/`` are always
+Rules (DS-R001 repeat-on-cache through DS-R011 unsharded-pool-placement /
+DS-R012 baked-constant-in-jit) are documented in the module and README
+("Static analysis"). Findings under ``tests/`` are always
 warn-only; error findings anywhere else exit nonzero — that is the CI gate
 ``tools/lint.sh`` wires into ``tools/fast_tests.sh``. Suppress a deliberate
 site with ``# lint: allow(DS-RXXX)`` on the offending line.
