@@ -69,6 +69,7 @@ METRICS = {
     "infinity": "zero_infinity_trainable_params_per_chip",
     "long_seq": "seq32k_flash_tokens_per_sec_per_chip",
     "moe_inference": "moe8x_top1_prefill_tokens_per_sec",
+    "moe_train": "moe_ep_train_tokens_per_sec",
     "decode_serving": "decode_tokens_per_sec_per_chip",
     "decode_serving_tp": "tp_decode_tokens_per_sec_per_chip",
     "fleet_serving": "fleet_goodput_tokens_per_sec",
@@ -811,9 +812,23 @@ def bench_infinity_max_params():
 
 
 def bench_long_seq():
-    """Config 4 (one chip): 32k-token sequences via the Pallas flash kernel
-    + remat (the single-chip leg of Ulysses; the seq axis itself needs a
-    multi-chip mesh, validated in dryrun phase 1)."""
+    """Config 4: long sequences. Full-size: 32k tokens via the Pallas flash
+    kernel + remat on one chip. TINY: the 2k config instead trains through
+    ``sequence/layer.py``'s Ulysses attention on a ``sequence=2`` mesh, so
+    the recorded collectives budget carries the head-scatter/seq-gather
+    all-to-alls (``ulysses_a2a_bytes`` — previously this bench ran single
+    chip and the a2a metric read 0; full-size sequence-parallel training
+    stays future work)."""
+    ulysses = bool(TINY)
+    if ulysses and "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""
+    ):
+        # the sequence axis needs a real mesh in this child (same pattern
+        # as the tp serving arm)
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=4"
+        )
     from deepspeed_tpu.models import TransformerLM
     from deepspeed_tpu.models.config import TransformerConfig
 
@@ -829,25 +844,30 @@ def bench_long_seq():
         activation="swiglu",
         use_bias=False,
         tie_embeddings=True,
-        remat=True,
-        flash_attention=True,
+        # Ulysses: the a2a exchange owns the head/seq reshard; the
+        # interpret-mode flash kernel can't run under it on CPU
+        remat=not ulysses,
+        flash_attention=not ulysses,
+        sequence_parallel=ulysses,
+        sequence_parallel_mode="ulysses",
     )
-    engine = _train_engine(
-        TransformerLM(mcfg),
-        {
-            "train_micro_batch_size_per_gpu": micro,
-            "optimizer": {"type": "adam", "params": {"lr": 1e-4}},
-            "bf16": {"enabled": True},
-            "zero_optimization": {"stage": 1},
-            "steps_per_print": 10_000,
-        },
-    )
+    config = {
+        "train_micro_batch_size_per_gpu": micro,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-4}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 1},
+        "steps_per_print": 10_000,
+    }
+    if ulysses:
+        config["mesh"] = {"sequence": 2, "data": 2}
+    engine = _train_engine(TransformerLM(mcfg), config)
+    dp = engine.data_parallel_world_size()
     rs = np.random.RandomState(SEED)
-    toks = rs.randint(0, mcfg.vocab_size, (micro, seq + 1)).astype(np.int32)
+    toks = rs.randint(0, mcfg.vocab_size, (micro * dp, seq + 1)).astype(np.int32)
     batch = {"input_ids": toks[:, :-1], "labels": toks[:, 1:]}
     steps = 5
     dt, _ = _timed_steps(engine, batch, warmup=2, steps=steps)
-    tps = steps * micro * seq / dt
+    tps = steps * micro * dp * seq / dt
     mfu = _mfu(tps, engine.num_parameters(), mcfg.num_layers, mcfg.hidden_size, seq)
     rec = {
         "metric": METRICS["long_seq"],
@@ -858,6 +878,10 @@ def bench_long_seq():
     }
     rec.update(_compile_fields(engine))
     rec.update(_analysis_fields(engine))
+    if ulysses:
+        a2a = _a2a_wire_summary(engine)
+        rec["sequence_parallel"] = "ulysses"
+        rec["ulysses_a2a_bytes"] = int(a2a["bytes"]) if a2a else 0
     return rec
 
 
@@ -905,9 +929,15 @@ def bench_moe_inference():
     moe_tps, moe_engine = prefill_tps(
         MoETransformerLM(MoETransformerConfig(num_experts=8, moe_top_k=1, **base))
     )
-    # analysis snapshot from the MoE engine (the measured object), before
-    # the dense baseline rebuilds the topology
-    analysis_fields = _analysis_fields(moe_engine)
+    # the full structural snapshot from the MoE engine (the measured
+    # object), before the dense baseline rebuilds the topology: compile
+    # telemetry, the comms/donation/overlap budget, and the HBM ledger
+    # (expert shards land in peak_hbm_bytes_per_chip via the PR-18
+    # estimator)
+    moe_fields = {}
+    moe_fields.update(_compile_fields(moe_engine))
+    moe_fields.update(_analysis_fields(moe_engine))
+    moe_fields.update(_memory_fields(moe_engine))
     dense_tps, _ = prefill_tps(TransformerLM(TransformerConfig(**base)))
     rec = {
         "metric": METRICS["moe_inference"],
@@ -915,7 +945,120 @@ def bench_moe_inference():
         "unit": "tokens/s",
         "vs_baseline": round(moe_tps / dense_tps, 4),
     }
-    rec.update(analysis_fields)
+    rec.update(moe_fields)
+    return rec
+
+
+def _a2a_wire_summary(engine):
+    """The collectives-pass ``all-to-all`` pricing for the engine's step
+    program: ``{count, bytes, wire_bytes, quantized{...}}`` or None when the
+    schedule has no a2a (the analysis never fails the bench record)."""
+    try:
+        rep = engine.analysis_report(passes=["collectives"])
+        for prog in rep["programs"].values():
+            coll = prog.get("passes", {}).get("collectives")
+            if not coll:
+                continue
+            a2a = coll.get("summary", {}).get("ops", {}).get("all-to-all")
+            if a2a:
+                return a2a
+    except Exception:
+        traceback.print_exc()
+    return None
+
+
+def bench_moe_train():
+    """Config 5b (data×expert mesh): expert-parallel MoE training — the
+    shard_map fast path with explicit dispatch/combine all-to-alls (ISSUE
+    20). ``value`` is trained tokens/s on the fp-wire arm; the int8 arm
+    re-prices the same schedule with the EQuARX-style wire format and
+    ``vs_baseline`` is its fp-equivalent-over-wire byte ratio (4.0 when
+    every a2a payload quantizes cleanly — the pure fp32/int8 dtype ratio).
+    ``overlap_verified`` rides the standard analysis block: every dispatch/
+    combine a2a must hide behind the PR-MoE residual / next-layer gating
+    compute (exposed loop-collective bytes == 0 — the
+    ``test_green_moe_programs`` training gate, recorded here per round)."""
+    # the expert axis needs a real mesh: force the 8-device CPU host mesh
+    # before this child initializes its backend (same pattern as the tp
+    # serving arm)
+    if CPU_ONLY and "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""
+    ):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        )
+    import jax
+
+    from deepspeed_tpu.models.moe_transformer import MoETransformerConfig, MoETransformerLM
+
+    n = len(jax.devices())
+    if n < 4 or n % 2:
+        return _error_record("moe_train", f"expert mesh needs >=4 even devices, have {n}")
+    mesh = {"data": n // 2, "expert": 2}
+    seq, micro = (32, 8) if TINY or CPU_ONLY else (512, 8)
+
+    def build(quantized):
+        # mirrors the gate-green config: PR-MoE residual gives the overlap
+        # pass real compute to hide the exchanges behind; fp32 keeps the
+        # int8-vs-fp wire ratio an exact dtype ratio; flash/remat off is
+        # the repo's CPU multi-device convention
+        cfg = MoETransformerConfig(
+            vocab_size=1024 if TINY or CPU_ONLY else 32000,
+            hidden_size=128 if TINY or CPU_ONLY else 1024,
+            num_layers=2 if TINY or CPU_ONLY else 8,
+            num_heads=2 if TINY or CPU_ONLY else 8,
+            max_seq_len=seq, norm="rmsnorm", position="rope",
+            activation="swiglu", use_bias=False, tie_embeddings=True,
+            num_experts=4 if TINY or CPU_ONLY else 8, moe_top_k=1,
+            scan_layers=True, use_residual=True, dtype="float32",
+            flash_attention=False, remat=False, moe_quantized_a2a=quantized,
+        )
+        engine = _train_engine(
+            MoETransformerLM(cfg),
+            {
+                "train_micro_batch_size_per_gpu": micro,
+                "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 3, "overlap_comm": True},
+                "mesh": mesh,
+                "steps_per_print": 10_000,
+            },
+        )
+        return cfg, engine
+
+    mcfg, engine = build(quantized=False)
+    rs = np.random.RandomState(SEED)
+    toks = rs.randint(0, mcfg.vocab_size, (micro, seq + 1)).astype(np.int32)
+    batch = {"input_ids": toks[:, :-1], "labels": toks[:, 1:]}
+    steps = 5 if TINY or CPU_ONLY else 20
+    dt, _ = _timed_steps(engine, batch, warmup=2, steps=steps)
+    tps = steps * micro * seq / dt
+    rec = {
+        "metric": METRICS["moe_train"],
+        "value": round(tps, 1),
+        "unit": "tokens/s",
+        "steps": steps,
+        "mesh": mesh,
+    }
+    rec.update(_compile_fields(engine))
+    rec.update(_analysis_fields(engine))
+    rec.update(_memory_fields(engine))
+    fp_a2a = _a2a_wire_summary(engine)
+    rec["a2a_wire_bytes_fp"] = int(fp_a2a["wire_bytes"]) if fp_a2a else 0
+
+    # int8 wire arm: same schedule, quantized dispatch/combine payloads —
+    # priced statically by the collectives pass (one engine, one step)
+    _qcfg, q_engine = build(quantized=True)
+    q_engine.train_batch(batch=batch)
+    q_a2a = _a2a_wire_summary(q_engine)
+    quant = (q_a2a or {}).get("quantized") or {}
+    rec["a2a_wire_bytes_int8"] = int(quant.get("wire_bytes", 0))
+    fp_equiv = int(quant.get("fp_equiv_wire_bytes", 0))
+    reduction = (
+        round(fp_equiv / quant["wire_bytes"], 4) if quant.get("wire_bytes") else 0
+    )
+    rec["a2a_wire_reduction"] = reduction
+    rec["vs_baseline"] = reduction
     return rec
 
 
@@ -1452,6 +1595,7 @@ CONFIGS = {
     "infinity": (bench_infinity_max_params, 360),
     "long_seq": (bench_long_seq, 360),
     "moe_inference": (bench_moe_inference, 300),
+    "moe_train": (bench_moe_train, 420),
     "decode_serving": (bench_decode_serving, 330),
     "decode_serving_tp": (bench_decode_serving_tp, 330),
     "fleet_serving": (bench_fleet_serving, 330),

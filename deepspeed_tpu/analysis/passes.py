@@ -486,11 +486,41 @@ def collectives_pass(
     # payload-byte accounting collect_collectives defined, so the legacy
     # per-op aggregate folds out of them instead of re-parsing the module
     details = hlo_parse.collect_collective_details(art.hlo_text)
+    # per-op-kind wire + quantized breakdown (ISSUE 20: the MoE dispatch/
+    # combine all-to-alls get the same dtype-aware pricing the quantized
+    # all-reduces got — the green gate reads ops["all-to-all"]["quantized"]
+    # to assert the int8 arm's wire bytes are exactly fp/4)
     ops: Dict[str, Dict[str, Any]] = {}
     for d in details:
-        rec = ops.setdefault(d["op"], {"count": 0, "bytes": 0})
+        rec = ops.setdefault(
+            d["op"],
+            {
+                "count": 0,
+                "bytes": 0,
+                "wire_bytes": 0.0,
+                "quantized": {
+                    "count": 0,
+                    "bytes": 0,
+                    "wire_bytes": 0.0,
+                    "fp_equiv_wire_bytes": 0.0,
+                },
+            },
+        )
         rec["count"] += 1
         rec["bytes"] += d["bytes"]
+        rec["wire_bytes"] += d["wire_bytes"]
+        if d["quantized_bytes"]:
+            q = rec["quantized"]
+            q["count"] += 1
+            q["bytes"] += d["quantized_bytes"]
+            q["wire_bytes"] += d["quantized_wire_bytes"]
+            q["fp_equiv_wire_bytes"] += d["fp_equiv_wire_bytes"]
+    for rec in ops.values():
+        rec["wire_bytes"] = int(round(rec["wire_bytes"]))
+        rec["quantized"]["wire_bytes"] = int(round(rec["quantized"]["wire_bytes"]))
+        rec["quantized"]["fp_equiv_wire_bytes"] = int(
+            round(rec["quantized"]["fp_equiv_wire_bytes"])
+        )
     total_bytes = sum(r["bytes"] for r in ops.values())
     total_count = sum(r["count"] for r in ops.values())
     res.summary = {"ops": ops, "total_bytes": total_bytes, "total_count": total_count}

@@ -20,7 +20,9 @@ Rules (each one traces back to a real incident in PERF.md / PR history):
   likely double-buffering a state-sized array.
 * **DS-R005 host-transfer-in-serving-loop** — ``jax.device_get`` /
   ``.item()`` / ``np.asarray``-on-a-device-value inside the serving step
-  loop (the step/round methods of a ``*Server`` / ``*Scheduler`` class):
+  loop (the step/round methods of a ``*Server`` / ``*Scheduler`` class,
+  and the routing methods — apply/gate/dispatch/combine — of a ``*Gate``
+  / ``*MoE`` / ``*MoELayer`` class, which run inside every traced step):
   every fetch beyond the one budgeted token fetch per dispatch adds a
   synchronous tunnel RTT (~2 ms, PERF.md) to EVERY serving round. The
   sanctioned single fetch per dispatch carries a pragma.
@@ -46,7 +48,9 @@ Rules (each one traces back to a real incident in PERF.md / PR history):
   (full async-dispatch drain), inside a step-loop method of an
   ``*Engine`` / ``*Server`` / ``*Scheduler`` / ``*Loader`` class (the
   multi-step window family and the prefetching input pipeline run on the
-  same critical path): ad-hoc timing forks a
+  same critical path), or a routing method of a ``*Gate`` / ``*MoE`` /
+  ``*MoELayer`` class (the expert dispatch path runs inside every traced
+  step — a clock there stalls the a2a overlap): ad-hoc timing forks a
   second, invisible timeline next to the unified tracer (ISSUE 10), and a
   stray ``device_sync`` serializes host and device on every step (the
   ``SynchronizedWallClockTimer.stop(sync=True)`` default this PR removed).
@@ -182,6 +186,18 @@ _SERVING_FN = re.compile(
 _HOT_FN = re.compile(
     r"^_?((plain_)?(decode|prefill|verify|spec|ragged|tp)_(step|round|window)"
     r"|settle_(ragged|window)_rows|settle_spec_row|step|run|serve)$"
+)
+
+# DS-R005/DS-R009 MoE routing scope (ISSUE 20): the gate/dispatch methods
+# of a ``*Gate`` / ``*MoE`` / ``*MoELayer`` class run INSIDE every traced
+# training and serving step — a host sync there (a ``.item()`` on an
+# exp_counts, a clock around the dispatch) stalls the a2a overlap pipeline
+# exactly like a fetch in a serving round. Unlike the Server/Scheduler
+# scope there is no serving-method qualifier: a routing class IS hot by
+# construction.
+_MOE_CLASS = re.compile(r"(Gate|MoE|MoELayer)$")
+_MOE_HOT_FN = re.compile(
+    r"^_?(apply|forward|route|gate|gating|top\d?k?gating|dispatch|combine)$"
 )
 _NP_CASTS = ("np.asarray", "np.array", "numpy.asarray", "numpy.array", "onp.asarray")
 
@@ -465,21 +481,27 @@ def lint_source(src: str, path: str = "<string>") -> List[LintFinding]:
 
     # ---- DS-R005: host transfers in the serving hot loop --------------
     for cls in ast.walk(tree):
-        if not (isinstance(cls, ast.ClassDef) and _HOT_CLASS.search(cls.name)):
+        if not isinstance(cls, ast.ClassDef):
             continue
-        if not any(
-            isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
-            and _SERVING_FN.match(m.name)
-            for m in cls.body
-        ):
-            continue  # a host-only scheduler, not the serving loop
+        if _HOT_CLASS.search(cls.name):
+            if not any(
+                isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and _SERVING_FN.match(m.name)
+                for m in cls.body
+            ):
+                continue  # a host-only scheduler, not the serving loop
+            fn_re, kind = _HOT_FN, "serving hot path"
+        elif _MOE_CLASS.search(cls.name):
+            fn_re, kind = _MOE_HOT_FN, "MoE routing path"
+        else:
+            continue
         for fn in cls.body:
             if not (
                 isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
-                and _HOT_FN.match(fn.name)
+                and fn_re.match(fn.name)
             ):
                 continue
-            where = f"serving hot path {cls.name}.{fn.name}"
+            where = f"{kind} {cls.name}.{fn.name}"
             for n in ast.walk(fn):
                 if not isinstance(n, ast.Call):
                     continue
@@ -508,12 +530,18 @@ def lint_source(src: str, path: str = "<string>") -> List[LintFinding]:
     # ---- DS-R009: raw clocks / device syncs in step-loop methods ------
     if not _R009_EXEMPT_PATH.search(path.replace(os.sep, "/")):
         for cls in ast.walk(tree):
-            if not (isinstance(cls, ast.ClassDef) and _R009_CLASS.search(cls.name)):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            if _R009_CLASS.search(cls.name):
+                fn_re = _R009_FN
+            elif _MOE_CLASS.search(cls.name):
+                fn_re = _MOE_HOT_FN  # gate/dispatch methods: same step path
+            else:
                 continue
             for fn in cls.body:
                 if not (
                     isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
-                    and _R009_FN.match(fn.name)
+                    and fn_re.match(fn.name)
                 ):
                     continue
                 where = f"step-loop method {cls.name}.{fn.name}"

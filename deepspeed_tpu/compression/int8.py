@@ -87,8 +87,13 @@ def qmatmul(h, w):
     arrays take the exact path the call sites used before."""
     if isinstance(w, QuantizedTensor):
         out = h @ w.q.astype(h.dtype)
-        # scale is [..., 1, out]; the product lost the contraction axis
-        return out * w.scale[..., 0, :].astype(h.dtype)
+        # scale is [..., 1, out]; the product lost the contraction axis.
+        # Batched weight stacks (the [E, H, I] expert FFNs) keep the
+        # size-1 axis so the scale broadcasts over the capacity dim.
+        scale = w.scale.astype(h.dtype)
+        if w.q.ndim == 2:
+            scale = scale[..., 0, :]
+        return out * scale
     return h @ w.astype(h.dtype)
 
 

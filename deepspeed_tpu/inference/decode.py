@@ -75,11 +75,55 @@ def _layer_project_qkv(cfg: TransformerConfig, p, h):
     )
 
 
+def _moe_ffn(cfg, p, h):
+    """Eval-mode MoE routing for a normed [B, T, H] slab (ISSUE 20 serving
+    tentpole): in-program top-k gate + capacity-bucketed expert einsum, the
+    exact inference semantics of ``moe/layer.py`` ``MoE.apply(train=False)``
+    — eval capacity factor, no gate noise, no RNG (deterministic drops).
+    Capacity is a Python int from the static token count, so shifting
+    expert-routing mixes are pure data: the paged programs never retrace.
+    Expert weights may be int8 (``quantize_params_int8`` stacks scales as
+    ``[E, 1, I]``); ``apply_expert_ffn`` fuses the dequantization."""
+    from deepspeed_tpu.moe import sharded_moe
+    from deepspeed_tpu.moe.experts import apply_dense_ffn, apply_expert_ffn
+
+    B, T, H = h.shape
+    tokens = h.reshape(-1, H)
+    logits = tokens.astype(jnp.float32) @ p["gate"]["wg"]
+    _l_aux, combine_w, dispatch_m, _counts = sharded_moe.topkgating(
+        logits,
+        cfg.moe_top_k,
+        cfg.eval_capacity_factor,
+        cfg.min_capacity,
+        drop_tokens=cfg.moe_drop_tokens,
+        rng=None,
+        noisy_gate_policy=None,
+        use_rts=cfg.moe_use_rts,
+    )
+    dispatched = sharded_moe.dispatch(tokens, dispatch_m)
+    expert_out = apply_expert_ffn(p["experts"], dispatched, cfg.activation)
+    out = sharded_moe.combine(expert_out, combine_w)
+    if "mlp" in p:
+        # PR-MoE residual branch: dense MLP in parallel, learned 2-way mix
+        mlp_out = apply_dense_ffn(p["mlp"], tokens, cfg.activation)
+        coef = tokens.astype(jnp.float32) @ p["coefficient"]["w"] + p["coefficient"]["b"]
+        coef = jax.nn.softmax(coef, axis=-1).astype(out.dtype)
+        out = out * coef[..., 0:1] + mlp_out * coef[..., 1:2]
+    return out.reshape(B, T, H)
+
+
 def _ffn_body(cfg: TransformerConfig, p, x, norm_scale, norm_bias, tp=None):
     """norm → ffn, NO residual — callers place the residual per architecture."""
     from deepspeed_tpu.moe.experts import apply_dense_ffn
 
     h = _norm(x, norm_scale, norm_bias, cfg.norm, cfg.norm_eps)
+    if "moe" in p:
+        if tp is not None:
+            raise NotImplementedError(
+                "tensor-parallel MoE serving is not supported: expert "
+                "placement is the 'expert' mesh axis, not a TP weight split"
+            )
+        return _moe_ffn(cfg, p["moe"], h)
     return apply_dense_ffn(p, h, cfg.activation, tp=tp)
 
 

@@ -251,6 +251,26 @@ class PagedServer:
         # journal, fleet routing) replicated and untouched. Requires the
         # ragged path: the bucketed oracle stays single-chip by contract.
         self.tp = tp
+        # MoE serving (ISSUE 20): the per-layer "moe" subtree routes inside
+        # the same paged programs (decode.py:_moe_ffn) — but only when the
+        # expert stack scans with the layers. Interleaved dense/MoE stacks
+        # (moe_layer_freq > 1) keep expert params OUTSIDE params["layers"],
+        # which the scanned serving body cannot see; and expert placement is
+        # the 'expert' mesh axis, not a TP weight split.
+        is_moe = isinstance(params, dict) and (
+            "moe" in params.get("layers", {}) or "moe_layers" in params
+        )
+        if is_moe and "moe_layers" in params:
+            raise NotImplementedError(
+                "paged serving supports MoE only with moe_layer_freq == 1 "
+                "(a scanned [L, E, ...] expert stack); interleaved "
+                "dense/MoE stacks keep experts outside the layer scan"
+            )
+        if is_moe and tp is not None and tp.degree > 1:
+            raise NotImplementedError(
+                "tensor-parallel MoE serving is not supported: expert "
+                "placement is the 'expert' mesh axis, not a TP weight split"
+            )
         if tp is not None:
             if not ragged:
                 raise ValueError(

@@ -41,6 +41,9 @@ class MoETransformerConfig(TransformerConfig):
     moe_use_rts: bool = True
     moe_aux_loss_coef: float = 0.01
     expert_intermediate_size: Optional[int] = None
+    # int8 wire format for the expert-parallel dispatch/combine all-to-alls
+    # (EQuARX-style per-chunk scales, moe/a2a.py:quantized_all_to_all)
+    moe_quantized_a2a: bool = False
 
     def __post_init__(self):
         super().__post_init__()
@@ -70,6 +73,7 @@ class MoETransformerLM(TransformerLM):
             activation=cfg.activation if cfg.activation in ("gelu", "relu", "swiglu", "geglu") else "gelu",
             use_bias=cfg.use_bias,
             out_std=0.02 / np.sqrt(2 * cfg.num_layers),
+            quantized_a2a=cfg.moe_quantized_a2a,
         )
         moe_layers = [i for i in range(cfg.num_layers) if self._is_moe_layer(i)]
         dense_layers = [i for i in range(cfg.num_layers) if not self._is_moe_layer(i)]

@@ -116,19 +116,26 @@ def init_expert_ffn(
 
 
 def apply_expert_ffn(params: Dict[str, Any], x: jnp.ndarray, activation: str = "gelu") -> jnp.ndarray:
-    """[E, C, H] → [E, C, H]: each expert's FFN on its capacity slice."""
+    """[E, C, H] → [E, C, H]: each expert's FFN on its capacity slice.
+    The batched ``x @ w`` contracts H per expert (einsum ``ech,ehi->eci``);
+    ``qmatmul`` fuses int8-weight dequantization when the stacked leaves
+    are quantized — its ``[E, 1, I]`` per-output-channel scales broadcast
+    over the capacity dim — so MoE serving rides the same int8 weights as
+    the dense path."""
+    from deepspeed_tpu.compression.int8 import qmatmul
+
     dt = x.dtype
     if activation in ("swiglu", "geglu"):
-        gate = jnp.einsum("ech,ehi->eci", x, params["w_gate"].astype(dt))
-        up = jnp.einsum("ech,ehi->eci", x, params["w_up"].astype(dt))
+        gate = qmatmul(x, params["w_gate"])
+        up = qmatmul(x, params["w_up"])
         act = jax.nn.silu(gate) if activation == "swiglu" else jax.nn.gelu(gate)
         inner = act * up
     else:
-        inner = jnp.einsum("ech,ehi->eci", x, params["w_in"].astype(dt))
+        inner = qmatmul(x, params["w_in"])
         if "b_in" in params:
             inner = inner + params["b_in"][:, None, :].astype(dt)
         inner = _pointwise_activation(inner, activation)
-    out = jnp.einsum("eci,eih->ech", inner, params["w_out"].astype(dt))
+    out = qmatmul(inner, params["w_out"]).astype(dt)
     if "b_out" in params:
         out = out + params["b_out"][:, None, :].astype(dt)
     return out

@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from deepspeed_tpu.moe import sharded_moe
+from deepspeed_tpu.moe import a2a, sharded_moe
 from deepspeed_tpu.moe.experts import (
     apply_dense_ffn,
     apply_expert_ffn,
@@ -58,6 +58,7 @@ class MoE:
         activation: str = "gelu",
         use_bias: bool = True,
         out_std: Optional[float] = None,
+        quantized_a2a: bool = False,
     ):
         self.hidden_size = hidden_size
         self.num_experts = num_experts
@@ -77,6 +78,9 @@ class MoE:
         self.activation = activation
         self.use_bias = use_bias
         self.out_std = out_std
+        # int8 dispatch/combine wire format (EQuARX-style); an active
+        # OverlapPlan's a2a stage overrides this layer-local default
+        self.quantized_a2a = quantized_a2a
 
     # --- params ---------------------------------------------------------
     def init(self, rng) -> Dict[str, Any]:
@@ -148,23 +152,65 @@ class MoE:
         logits = gate_in.astype(jnp.float32) @ params["gate"]["wg"]
 
         cf = self.capacity_factor if train else self.eval_capacity_factor
-        l_aux, combine_w, dispatch_m, exp_counts = sharded_moe.topkgating(
-            logits,
-            self.k,
-            cf,
-            self.min_capacity,
-            drop_tokens=self.drop_tokens,
-            rng=rng if train else None,
-            noisy_gate_policy=self.noisy_gate_policy if train else None,
-            use_rts=self.use_rts,
-            used_token_mask=used_token_mask,
-        )
+        from deepspeed_tpu.parallel.mesh import _TOPOLOGY
 
-        dispatched = sharded_moe.dispatch(tokens, dispatch_m)
-        dispatched = self._constrain(dispatched, P("expert", None, None))
-        expert_out = apply_expert_ffn(params["experts"], dispatched, self.activation)
-        expert_out = self._constrain(expert_out, P("expert", None, None))
-        out = sharded_moe.combine(expert_out, combine_w)
+        if a2a.ep_fast_path(_TOPOLOGY, self.num_experts, tokens.shape[0]):
+            # expert-parallel fast path: per-shard gating + explicit
+            # dispatch/combine all-to-alls (moe/a2a.py). The dispatch a2a is
+            # emitted before the residual/shared-dense branch and the combine
+            # before the next layer's gating — both independent of that
+            # compute, so the overlap pass finds real work to hide them
+            # behind. Wire format comes from the engine's OverlapPlan a2a
+            # stage when one is active (training trace), else the layer knob.
+            from deepspeed_tpu.runtime.zero.overlap import active_plan
+
+            plan = active_plan()
+            quantized = (
+                plan.a2a_quantized
+                if plan is not None and plan.a2a_quantized is not None
+                else self.quantized_a2a
+            )
+            dispatched, combine_w, l_aux_shards, count_shards = a2a.ep_gate_dispatch(
+                tokens,
+                logits,
+                _TOPOLOGY,
+                k=self.k,
+                capacity_factor=cf,
+                min_capacity=self.min_capacity,
+                drop_tokens=self.drop_tokens,
+                use_rts=self.use_rts,
+                noisy_gate_policy=self.noisy_gate_policy if train else None,
+                rng=rng if train else None,
+                used_token_mask=used_token_mask,
+                quantized=quantized,
+            )
+            rest = tuple(
+                x for x in a2a.token_shard_axes(_TOPOLOGY) if x != "expert"
+            )
+            ep_spec = P("expert", rest if rest else None, None)
+            expert_out = apply_expert_ffn(params["experts"], dispatched, self.activation)
+            expert_out = self._constrain(expert_out, ep_spec)
+            out = a2a.ep_combine(expert_out, combine_w, _TOPOLOGY, quantized=quantized)
+            l_aux = jnp.mean(l_aux_shards)
+            exp_counts = jnp.sum(count_shards, axis=0)
+        else:
+            l_aux, combine_w, dispatch_m, exp_counts = sharded_moe.topkgating(
+                logits,
+                self.k,
+                cf,
+                self.min_capacity,
+                drop_tokens=self.drop_tokens,
+                rng=rng if train else None,
+                noisy_gate_policy=self.noisy_gate_policy if train else None,
+                use_rts=self.use_rts,
+                used_token_mask=used_token_mask,
+            )
+
+            dispatched = sharded_moe.dispatch(tokens, dispatch_m)
+            dispatched = self._constrain(dispatched, P("expert", None, None))
+            expert_out = apply_expert_ffn(params["experts"], dispatched, self.activation)
+            expert_out = self._constrain(expert_out, P("expert", None, None))
+            out = sharded_moe.combine(expert_out, combine_w)
 
         if self.use_residual:
             mlp_out = apply_dense_ffn(params["mlp"], tokens, self.activation)

@@ -1139,6 +1139,32 @@ class DeepSpeedEngine:
         else:
             loss_of = base_loss_of
 
+        # fp32 training stores the ZeRO-sharded fp32 master AS the compute
+        # params (one tree, threshold-0 master layout) — which silently
+        # defeats stage3_param_persistence_threshold: leaves the partitioner
+        # keeps replicated under mixed precision arrive sharded, and their
+        # use-point gathers land INSIDE the remat'd backward scan, where the
+        # first-op norm scales have no independent compute to hide behind
+        # (the overlap pass flags them as exposed loop collectives). Re-pin
+        # the training/eval view of the tree to the persistence-honoring
+        # param specs before the forward: persistent leaves materialize
+        # replicated ONCE per step outside the scan, non-persistent leaves
+        # keep the master layout (their param spec is the same sharded one).
+        # Value-preserving; a no-op under mixed precision (params already
+        # carry param_specs) and when the threshold is 0.
+        if mixed:
+            pin_persistent = lambda p: p  # noqa: E731
+        else:
+            _pspecs = self._param_specs
+
+            def pin_persistent(params):
+                return jax.tree_util.tree_map(
+                    lambda t, s: jax.lax.with_sharding_constraint(t, NamedSharding(mesh, s)),
+                    params,
+                    _pspecs,
+                    is_leaf=lambda x: isinstance(x, PartitionSpec),
+                )
+
         # comm-overlap plan (runtime/zero/overlap.py): activated trace-time
         # around every training loss, so the scanned layer stack pipelines
         # its stage-3 param gathers (layer i+1's all-gather issued during
@@ -1170,6 +1196,8 @@ class DeepSpeedEngine:
         self._loss_of = loss_of
 
         def fwd_bwd(params, grad_acc, scale, rng, batch, model_kwargs):
+            params = pin_persistent(params)
+
             def scaled_loss(p):
                 return loss_of(p, batch, rng, model_kwargs) * scale.astype(jnp.float32)
 
@@ -1223,7 +1251,7 @@ class DeepSpeedEngine:
                 from deepspeed_tpu.runtime.zero.zeropp import qwz_gather_tree
 
                 params = qwz_gather_tree(params, self._param_specs, self.topology)
-            out = module.apply(params, batch, rngs={"dropout": rng}, train=False)
+            out = module.apply(pin_persistent(params), batch, rngs={"dropout": rng}, train=False)
             return out
 
         self._jit_eval = self._telemetry.instrument("eval_fwd", eval_fwd)
@@ -1297,6 +1325,7 @@ class DeepSpeedEngine:
             microbatch at gas=1, the stacked ``[gas, ...]`` microbatches
             otherwise. Returns the new state plus the step's loss, grad
             norm, overflow flag, and pre-update scale."""
+            params = pin_persistent(params)
             scale = scale_state.scale
             rng, sub = jax.random.split(rng)
             if gas == 1:
@@ -1772,6 +1801,9 @@ class DeepSpeedEngine:
             self._param_specs["layers"],
             self._grad_specs["layers"],
             num_layers,
+            # a2a-stage wire format: the MoE model family's knob rides the
+            # plan so the layer reads one source of truth while tracing
+            moe_quantized_a2a=getattr(mcfg, "moe_quantized_a2a", None),
         )
         if plan is not None and plan.prefetch_enabled and (
             self.progressive_layer_drop is not None
@@ -1790,7 +1822,7 @@ class DeepSpeedEngine:
             )
             plan.prefetch_enabled = False
             plan.depth = 0
-            if not plan.reduce_enabled:
+            if not plan.reduce_enabled and not plan.a2a_enabled:
                 plan = None
         return plan
 
@@ -2358,6 +2390,9 @@ class DeepSpeedEngine:
         """Post-update host tail shared by every step flavor: counters,
         fp16 overflow accounting (the only host-visible sync, and only under
         fp16), lr scheduler, monitor."""
+        # the classic preemption instant: device state updated, nothing of
+        # the step committed host-side yet
+        chaos.point("train.mid_step")
         self.global_steps += 1
         if self._config.fp16_enabled and overflow_flag is not None:
             self._overflow = (

@@ -99,6 +99,23 @@ class OverlapPlan:
     reduce_bucket_elems: int
     leaves: List[_LeafInfo] = field(default_factory=list)
     treedef: Any = None
+    # --- a2a stage (expert-parallel MoE dispatch/combine) --------------
+    # The MoE layer family reads these through active_plan() while tracing:
+    # a2a_axis names the mesh axis the dispatch/combine all-to-alls run
+    # over, and a2a_quantized selects the int8 wire format of
+    # moe/a2a.py:quantized_all_to_all (None defers to the layer's own
+    # knob). The a2as themselves are emitted by the layer — dispatch
+    # before the shared-expert/dense branch so XLA schedules it behind
+    # that independent compute, combine before the next layer's gating —
+    # and the overlap analysis pass verifies the schedule has real
+    # compute to hide each one behind.
+    a2a_axis: Optional[str] = None
+    a2a_world: int = 1
+    a2a_quantized: Optional[bool] = None
+
+    @property
+    def a2a_enabled(self) -> bool:
+        return self.a2a_axis is not None and self.a2a_world > 1
 
     # --- pipelined parameter gather ------------------------------------
     def pin_gathered(self, per_layer: Any) -> Any:
@@ -292,11 +309,13 @@ def build_overlap_plan(
     stacked_param_specs: Any,
     stacked_grad_specs: Any,
     num_layers: int,
+    moe_quantized_a2a: Optional[bool] = None,
 ) -> Optional[OverlapPlan]:
     """Build the plan from the ZeRO config + the STACKED ``params['layers']``
     trees (arrays-or-shaped leaves + param/grad PartitionSpecs, leading dim
-    = L). Returns None when neither transform is enabled (stage < 2, or
-    overlap off with no explicit ``prefetch_layers``).
+    = L). Returns None when no stage is enabled: neither ZeRO transform
+    (stage < 2, or overlap off with no explicit ``prefetch_layers``) nor
+    the expert-parallel a2a stage (mesh has no real ``expert`` axis).
 
     ``prefetch_layers`` semantics: ``None`` → one layer of lookahead when
     stage-3 overlap is on (the reference's default prefetch), nothing
@@ -317,13 +336,19 @@ def build_overlap_plan(
         prefetch_layers = 1
     prefetch = stage >= 3 and prefetch_layers is not None
     reduce_ = stage >= 2 and overlap and bool(zero_config.reduce_scatter)
-    if not prefetch and not reduce_:
+    # a2a stage: armed whenever the mesh has a real expert axis — the MoE
+    # layer family routes its dispatch/combine exchange through it
+    a2a_world = int(topo.axis_size("expert")) if "expert" in topo.mesh.axis_names else 1
+    a2a = a2a_world > 1
+    if not prefetch and not reduce_ and not a2a:
         return None
 
     zero_axes = tuple(topo.zero_shard_axes)
     zero_world = int(np.prod([topo.axis_size(a) for a in zero_axes])) if zero_axes else 1
     if zero_world <= 1:
-        return None
+        prefetch = reduce_ = False
+        if not a2a:
+            return None
     drop = set(zero_axes)
     # size-1 mesh axes don't partition anything: ignore them when deciding
     # what a leaf's "real" sharding is (TP rules emit 'model' entries even
@@ -390,7 +415,7 @@ def build_overlap_plan(
         if gathered_elems == 0:
             prefetch = False  # nothing is ZeRO-sharded (all persistent)
             depth = 0
-    if not prefetch and not reduce_:
+    if not prefetch and not reduce_ and not a2a:
         return None
 
     return OverlapPlan(
@@ -403,6 +428,9 @@ def build_overlap_plan(
         reduce_bucket_elems=int(zero_config.reduce_bucket_size) or 1,
         leaves=leaves,
         treedef=treedef,
+        a2a_axis="expert" if a2a else None,
+        a2a_world=a2a_world,
+        a2a_quantized=moe_quantized_a2a,
     )
 
 

@@ -28,6 +28,12 @@ Injection points (the canonical set — sites call ``chaos.point(NAME, ...)``):
   a kill here must resume bit-identically from the last committed
   checkpoint (windows never straddle a checkpoint interval, so that
   checkpoint sits at or before the window's first step)
+* ``train.mid_step``       — a single optimizer step: the step program was
+  dispatched and the engine adopted the donated state, but none of the
+  host bookkeeping (counters, lr schedule, interval checkpoint) committed;
+  a kill here must resume bit-identically from the last committed
+  checkpoint — exercised on the expert-sharded MoE config, whose param
+  tree spans two mesh axes
 * ``journal.append``       — right after a journal record batch reaches the
   OS (the classic torn-tail instant; pair with the ``truncate`` action)
 * ``fleet.replica_kill``   — at the top of one replica's turn inside the
@@ -94,6 +100,11 @@ POINTS = (
     # window's tokens are buffered in the journal, none yet acked
     "train.mid_window",  # training window dispatched + state adopted, loss
     # drain not yet run and no step of the window committed to the counters
+    "train.mid_step",  # a single optimizer step: the step program dispatched
+    # and the donated state adopted, but the counters / lr schedule / interval
+    # checkpoint not yet committed — resume must replay from the last
+    # committed checkpoint bit-identically (the MoE expert-sharded state
+    # rides the same contract as the dense tree)
     "train.mid_offload_stream",  # ZeRO-Infinity streamed step, mid-bucket:
     # some host offload buffers updated, others not, the step uncommitted —
     # resume must rebuild the host state from the last checkpoint, never

@@ -863,3 +863,71 @@ def test_cli_rule_filter_rejects_unknown(tmp_path):
 
     with pytest.raises(SystemExit):
         main([str(tmp_path), "--rule", "DS-R999"])
+
+
+def test_r005_moe_routing_host_sync_flagged():
+    """ISSUE 20 extension: host transfers inside the routing methods of a
+    *Gate / *MoE / *MoELayer class run inside every traced step — each is
+    one synchronous RTT stalling the a2a overlap pipeline. Red."""
+    rules = _rules("""
+        import numpy as np, jax
+        class TopKGate:
+            def forward(self, logits):
+                counts = jax.device_get(self.exp_counts)
+                return counts
+        class MoE:
+            def apply(self, params, x):
+                n = self.capacity.item()
+                return n
+        class ShardedMoELayer:
+            def dispatch(self, tokens):
+                return np.asarray(self.dispatch_mask)
+    """)
+    assert rules.count("DS-R005") == 3
+
+
+def test_r009_moe_routing_raw_clock_flagged():
+    """A raw clock around the gate/dispatch path forks a second timeline
+    next to the tracer and serializes the dispatch a2a. Red."""
+    rules = _rules("""
+        import time
+        class TopKGate:
+            def gate(self, logits):
+                t0 = time.perf_counter()
+                return t0
+        class PRMoELayer:
+            def combine(self, expert_out):
+                return time.time()
+    """)
+    assert rules.count("DS-R009") == 2
+
+
+def test_moe_routing_scope_quiet_on_cold_methods():
+    """Out of scope: init/partition methods of MoE classes (host-side
+    setup, not the routing path), and config-ish classes whose names end
+    MoE-ish but define no routing methods."""
+    assert "DS-R005" not in _rules("""
+        import numpy as np
+        class MoE:
+            def init(self, rng):
+                return np.asarray(self.seed)  # setup, not routing
+            def partition_rules(self):
+                return np.asarray(self.rules)
+        class DeepSpeedMoEConfig:
+            def validate(self):
+                return np.asarray(self.moe_experts)  # no routing methods
+    """)
+    assert "DS-R009" not in _rules("""
+        import time
+        class MoE:
+            def init(self, rng):
+                return time.perf_counter()  # setup may time freely
+    """)
+
+
+def test_moe_package_lints_clean_under_routing_scope():
+    """The real moe/ package (gate + dispatch + a2a fast path) must lint
+    clean under the extended routing-path scope — the hot path stays free
+    of host syncs and raw clocks by construction."""
+    findings = lint_paths([os.path.join(REPO, "deepspeed_tpu", "moe")])
+    assert [f for f in findings if f.rule in ("DS-R005", "DS-R009")] == []

@@ -121,6 +121,18 @@
 # runtime claims: streamed zero-3 offload holds ≤2 buckets on device with
 # the fp32 master host-side, and tp=4 serving holds KV bytes/chip ==
 # total/tp with page tables host-side + 0 undeclared reshard collectives.
+# +expert-parallel MoE fast path 2026-08-07 (tests/unit/moe below;
+# test_passes.py::test_green_moe_programs rides the lint.sh analysis
+# suite; DS-R005/DS-R009 *Gate/*MoE/*MoELayer routing-path lint
+# extensions ride test_source_lint.py): expert-sharded training with
+# explicit overlapped dispatch/combine all-to-alls (moe/a2a.py) — top-1/
+# top-2 gating parity vs the dense-dispatch reference, deterministic
+# capacity-overflow drops, expert-sharded checkpoint roundtrip bit-
+# identity, train.mid_step chaos resume on the MoE config; the green
+# gate pins 1 dispatch/step + full donation + every a2a hidden (exposed
+# loop-collective bytes == 0) + int8 a2a wire == fp/4, and MoE routing
+# inside the ragged serving programs at ≤2 compiles with zero retraces
+# over shifting expert mixes.
 cd "$(dirname "$0")/.." || exit 1
 sh tools/lint.sh || exit 1
 exec python -m pytest -q \
@@ -156,4 +168,5 @@ exec python -m pytest -q \
   tests/unit/utils/test_groups.py \
   tests/unit/comm/test_collectives.py \
   tests/unit/compression/test_compression.py \
+  tests/unit/moe \
   "$@"
